@@ -1,0 +1,262 @@
+"""Core data-pipeline types (reference ``dataset/DataSet.scala:46,110,164``,
+``Transformer.scala:41``, ``Sample.scala:32``, ``Types.scala:73``).
+
+The reference's pipeline is iterator→iterator Transformer stages over Spark
+RDD partitions; ours is the same composable-iterator model over host numpy,
+feeding device arrays at the last step. TPU-specific duties of the last stage
+(``SampleToBatch``): produce *static-shaped* batches (drop or pad the
+remainder — XLA recompiles per shape, so ragged final batches are the enemy)
+and stack into contiguous numpy ready for a single host→device transfer.
+
+Composition uses ``>>`` where Scala used ``->``:
+    pipeline = BytesToGreyImg() >> GreyImgNormalizer(mean, std) >> GreyImgToBatch(128)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Generic, Iterator, List, Optional, Sequence, TypeVar
+
+import numpy as np
+
+from bigdl_tpu.utils.rng import RandomGenerator
+
+A = TypeVar("A")
+B = TypeVar("B")
+C = TypeVar("C")
+
+
+class Sample:
+    """One (feature, label) record (reference ``dataset/Sample.scala:32``)."""
+
+    __slots__ = ("feature", "label")
+
+    def __init__(self, feature, label):
+        self.feature = np.asarray(feature)
+        self.label = np.asarray(label)
+
+    def __repr__(self):
+        return f"Sample(feature={self.feature.shape}, label={self.label.shape})"
+
+
+class MiniBatch:
+    """One batch pair (reference ``dataset/Types.scala:73``)."""
+
+    __slots__ = ("data", "labels")
+
+    def __init__(self, data, labels):
+        self.data = data
+        self.labels = labels
+
+    def size(self) -> int:
+        return int(self.data.shape[0])
+
+    def __iter__(self):
+        yield self.data
+        yield self.labels
+
+
+class ByteRecord:
+    """Raw bytes + label (reference ``dataset/Types.scala:79``)."""
+
+    __slots__ = ("data", "label")
+
+    def __init__(self, data: bytes, label: float):
+        self.data = data
+        self.label = label
+
+
+class Transformer(Generic[A, B]):
+    """Iterator→iterator stage (reference ``dataset/Transformer.scala:41``)."""
+
+    def __call__(self, prev: Iterator[A]) -> Iterator[B]:
+        raise NotImplementedError
+
+    def __rshift__(self, other: "Transformer[B, C]") -> "ChainedTransformer":
+        return ChainedTransformer(self, other)
+
+    def clone_transformer(self) -> "Transformer":
+        import copy
+        return copy.deepcopy(self)
+
+
+class ChainedTransformer(Transformer[A, C]):
+    """reference ``ChainedTransformer`` (the ``->`` combinator)."""
+
+    def __init__(self, first: Transformer, second: Transformer):
+        self.first, self.second = first, second
+
+    def __call__(self, prev: Iterator) -> Iterator:
+        return self.second(self.first(prev))
+
+
+class Identity(Transformer[A, A]):
+    """reference ``dataset/Transformer.scala`` Identity."""
+
+    def __call__(self, prev: Iterator[A]) -> Iterator[A]:
+        return prev
+
+
+class SampleToBatch(Transformer[Sample, MiniBatch]):
+    """Collate Samples into static-shape MiniBatches
+    (reference ``dataset/Transformer.scala:129``).
+
+    ``feature_padding``/``label_padding`` + ``fixed_length`` reproduce the
+    reference's variable-length text handling (pad every sample to a fixed
+    sequence length so XLA sees one shape). ``drop_remainder`` keeps batch
+    shape static; the evaluator pads the tail batch instead.
+    """
+
+    def __init__(self, batch_size: int,
+                 feature_padding: Optional[float] = None,
+                 label_padding: Optional[float] = None,
+                 fixed_length: Optional[int] = None,
+                 drop_remainder: bool = True):
+        self.batch_size = batch_size
+        self.feature_padding = feature_padding
+        self.label_padding = label_padding
+        self.fixed_length = fixed_length
+        self.drop_remainder = drop_remainder
+
+    def _pad_to(self, arr: np.ndarray, length: int, value: float) -> np.ndarray:
+        if arr.shape[0] >= length:
+            return arr[:length]
+        pad = [(0, length - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+        return np.pad(arr, pad, constant_values=value)
+
+    def __call__(self, prev: Iterator[Sample]) -> Iterator[MiniBatch]:
+        buf: List[Sample] = []
+        for s in prev:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf and not self.drop_remainder:
+            yield self._collate(buf)
+
+    def _collate(self, samples: List[Sample]) -> MiniBatch:
+        if self.feature_padding is not None or self.fixed_length is not None:
+            length = self.fixed_length or max(s.feature.shape[0] for s in samples)
+            feats = np.stack([self._pad_to(s.feature, length,
+                                           self.feature_padding or 0.0)
+                              for s in samples])
+            labs = np.stack([self._pad_to(np.atleast_1d(s.label), length,
+                                          self.label_padding)
+                             if self.label_padding is not None
+                             else np.atleast_1d(s.label)
+                             for s in samples])
+        else:
+            feats = np.stack([s.feature for s in samples])
+            labs = np.stack([s.label for s in samples])
+        if labs.ndim == 2 and labs.shape[1] == 1:
+            labs = labs[:, 0]
+        return MiniBatch(feats, labs)
+
+
+# --------------------------------------------------------------------------
+# DataSets
+# --------------------------------------------------------------------------
+
+class AbstractDataSet(Generic[A]):
+    """reference ``dataset/DataSet.scala:46``."""
+
+    def data(self, train: bool) -> Iterator[A]:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        raise NotImplementedError
+
+    def is_distributed(self) -> bool:
+        return False
+
+    def transform(self, transformer: Transformer) -> "AbstractDataSet":
+        return TransformedDataSet(self, transformer)
+
+    # Scala's `->`
+    def __rshift__(self, transformer: Transformer) -> "AbstractDataSet":
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet[A]):
+    """In-memory dataset (reference ``LocalArrayDataSet``,
+    ``DataSet.scala:128``). ``data(train=True)`` iterates one shuffled epoch;
+    the optimizer loops epochs (explicit epochs replace the reference's
+    endless iterator + epoch arithmetic)."""
+
+    def __init__(self, data: Sequence[A]):
+        self._data = list(data)
+        self._order = np.arange(len(self._data))
+
+    def data(self, train: bool) -> Iterator[A]:
+        if train:
+            for i in self._order:
+                yield self._data[i]
+        else:
+            yield from self._data
+
+    def size(self) -> int:
+        return len(self._data)
+
+    def shuffle(self) -> None:
+        RandomGenerator.RNG().shuffle(self._order)
+
+
+class TransformedDataSet(AbstractDataSet[B]):
+    """DataSet with a transformer chain applied lazily per epoch."""
+
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def data(self, train: bool) -> Iterator[B]:
+        return self.transformer(self.base.data(train))
+
+    def size(self) -> int:
+        return self.base.size()
+
+    def shuffle(self) -> None:
+        self.base.shuffle()
+
+    def is_distributed(self) -> bool:
+        return self.base.is_distributed()
+
+    def transform(self, transformer: Transformer) -> "AbstractDataSet":
+        return TransformedDataSet(self.base, self.transformer >> transformer)
+
+
+class DistributedDataSet(LocalDataSet[A]):
+    """Dataset destined for the multi-chip training path
+    (reference ``DistributedDataSet``, ``DataSet.scala:164``).
+
+    The reference pins cached partitions to executors
+    (``CachedDistriDataSet``); on TPU the analogue is: the host pipeline
+    produces one *global* batch per step and ``DistriOptimizer`` shards it
+    over the mesh's data axis (device placement replaces partition locality).
+    In true multi-host runs each host would hold `1/process_count` of the
+    records — the sharding contract is identical either way.
+    """
+
+    def is_distributed(self) -> bool:
+        return True
+
+    def to_distributed(self) -> "DistributedDataSet":
+        return self
+
+
+class DataSet:
+    """Factory namespace (reference ``DataSet`` object, ``DataSet.scala:319``)."""
+
+    @staticmethod
+    def array(data: Sequence, distributed: bool = False):
+        return DistributedDataSet(data) if distributed else LocalDataSet(data)
+
+    @staticmethod
+    def sort(data: Sequence[Sample], key=lambda s: s.feature.shape[0],
+             distributed: bool = False):
+        """Length-bucketing for variable-length samples
+        (reference ``DataSet.sortRDD``, ``DataSet.scala:373-401``)."""
+        ordered = sorted(data, key=key)
+        return DataSet.array(ordered, distributed)

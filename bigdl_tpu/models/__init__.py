@@ -1,0 +1,3 @@
+"""bigdl_tpu.models — reference workloads (reference ``$B/models/``)."""
+
+from bigdl_tpu.models import lenet

@@ -1,0 +1,347 @@
+"""Module protocol: Torch-style stateful API over a functional JAX core.
+
+Reference parity: ``nn/abstractnn/AbstractModule.scala:50`` — the reference's
+modules are mutable objects with imperative ``forward``/``backward``, cached
+``output``/``gradInput``, and hand-written per-layer gradients. A line-for-line
+port would fight XLA (Python-side mutation can't be traced). The TPU-native
+design splits the two roles the reference conflates:
+
+1. **Module objects** (this file) hold hyper-parameters, parameter *values*,
+   and the ``forward`` computation written in ordinary jax.numpy. They keep the
+   reference's ergonomics: ``Sequential().add(Linear(2, 3)).add(ReLU())``,
+   ``module.forward(x)``, ``module.parameters()``, train/eval mode.
+
+2. **functional_apply(module, params, buffers, ...)** re-expresses any module
+   as a *pure function* of a parameter pytree. Everything the optimizer jits —
+   forward, loss, gradients (via ``jax.grad``, replacing the reference's
+   hand-written ``updateGradInput``/``accGradParameters``), and the SPMD
+   collectives — goes through this pure view. The module object's arrays are
+   snapshotted and restored around the traced call, so tracing never leaks
+   tracers into user-visible state.
+
+Gradients come from autodiff rather than per-layer backward methods; the
+``backward(input, grad_output)`` API is still provided (via ``jax.vjp``) for
+reference-parity and tests.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import copy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.utils.rng import RandomGenerator
+from bigdl_tpu.utils.table import Table
+
+Activity = Union[jax.Array, Table, Tuple, List]
+
+
+class RngStream:
+    """Splittable PRNG stream bound during functional apply (dropout etc.)."""
+
+    def __init__(self, key: Optional[jax.Array]):
+        self._key = key
+
+    def next_key(self) -> jax.Array:
+        if self._key is None:
+            # Eager convenience path: draw from the global generator.
+            return RandomGenerator.RNG().next_key()
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+_RNG_CTX: contextvars.ContextVar[Optional[RngStream]] = contextvars.ContextVar(
+    "bigdl_tpu_rng", default=None)
+
+
+def current_rng() -> RngStream:
+    stream = _RNG_CTX.get()
+    if stream is None:
+        return RngStream(None)
+    return stream
+
+
+class Module:
+    """Base module (reference ``AbstractModule``).
+
+    Subclasses declare parameters/buffers in ``__init__`` via
+    ``register_parameter``/``register_buffer`` (or by assigning the result of
+    an init helper) and implement ``update_output(*inputs)`` using jax.numpy.
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        d = object.__setattr__
+        d(self, "_parameters", {})   # name -> jax.Array (trainable)
+        d(self, "_buffers", {})      # name -> jax.Array (running stats etc.)
+        d(self, "_modules", {})      # name -> Module
+        d(self, "training", True)
+        d(self, "name", name or type(self).__name__)
+        d(self, "output", None)
+        d(self, "grad_input", None)
+        d(self, "_param_regularizers", {})  # name -> Regularizer or None
+
+    # ------------------------------------------------------------------ state
+    def register_parameter(self, name: str, value, regularizer=None) -> None:
+        self._parameters[name] = jnp.asarray(value)
+        if regularizer is not None:
+            self._param_regularizers[name] = regularizer
+
+    def register_buffer(self, name: str, value) -> None:
+        self._buffers[name] = jnp.asarray(value)
+
+    def add_module(self, name: str, module: "Module") -> None:
+        self._modules[name] = module
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, Module):
+            self._modules[name] = value
+        elif name in self._parameters:
+            self._parameters[name] = value
+        elif name in self._buffers:
+            self._buffers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str) -> Any:
+        # Called only when normal lookup fails.
+        for store in ("_parameters", "_buffers", "_modules"):
+            d = object.__getattribute__(self, store)
+            if name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!s} has no attribute {name!r}")
+
+    # Pytree views -----------------------------------------------------------
+    def parameter_tree(self) -> Dict[str, Any]:
+        tree = dict(self._parameters)
+        for name, child in self._modules.items():
+            sub = child.parameter_tree()
+            if sub:
+                tree[name] = sub
+        return tree
+
+    def buffer_tree(self) -> Dict[str, Any]:
+        tree = dict(self._buffers)
+        for name, child in self._modules.items():
+            sub = child.buffer_tree()
+            if sub:
+                tree[name] = sub
+        return tree
+
+    def load_parameter_tree(self, tree: Dict[str, Any]) -> None:
+        for name in self._parameters:
+            if name in tree:
+                self._parameters[name] = tree[name]
+        for name, child in self._modules.items():
+            if name in tree:
+                child.load_parameter_tree(tree[name])
+
+    def load_buffer_tree(self, tree: Dict[str, Any]) -> None:
+        for name in self._buffers:
+            if name in tree:
+                self._buffers[name] = tree[name]
+        for name, child in self._modules.items():
+            if name in tree:
+                child.load_buffer_tree(tree[name])
+
+    def named_modules(self, prefix: str = "") -> List[Tuple[str, "Module"]]:
+        out = [(prefix or self.name, self)]
+        for name, child in self._modules.items():
+            out.extend(child.named_modules(f"{prefix}.{name}" if prefix else name))
+        return out
+
+    def modules(self) -> List["Module"]:
+        return [m for _, m in self.named_modules()]
+
+    def apply_to_modules(self, fn: Callable[["Module"], None]) -> "Module":
+        for m in self.modules():
+            fn(m)
+        return self
+
+    def __call__(self, *inputs: Activity) -> Activity:
+        return self.forward(*inputs)
+
+    def regularizer_tree(self) -> Dict[str, Any]:
+        """Pytree (matching parameter_tree) of per-parameter regularizers."""
+        tree = {name: self._param_regularizers.get(name)
+                for name in self._parameters}
+        for name, child in self._modules.items():
+            sub = child.regularizer_tree()
+            if sub:
+                tree[name] = sub
+        return tree
+
+    # ---------------------------------------------------------------- forward
+    def update_output(self, *inputs: Activity) -> Activity:
+        raise NotImplementedError
+
+    def forward(self, *inputs: Activity) -> Activity:
+        self.output = self.update_output(*inputs)
+        return self.output
+
+    def backward(self, input: Activity, grad_output: Activity) -> Activity:
+        """Input gradient via autodiff (parity with reference ``backward``;
+        the training loop itself uses ``jax.grad`` over the whole loss)."""
+        params = self.parameter_tree()
+        buffers = self.buffer_tree()
+
+        def fwd(p, x):
+            out, _ = functional_apply(self, p, buffers, x, training=self.training)
+            return out
+
+        _, vjp = jax.vjp(lambda x: fwd(params, x), input)
+        self.grad_input = vjp(grad_output)[0]
+        return self.grad_input
+
+    # -------------------------------------------------------------- lifecycle
+    def reset(self) -> None:
+        """Re-initialise parameters (layers override)."""
+        for child in self._modules.values():
+            child.reset()
+
+    def training_mode(self) -> "Module":
+        self.training = True
+        for child in self._modules.values():
+            child.training_mode()
+        return self
+
+    def evaluate_mode(self) -> "Module":
+        self.training = False
+        for child in self._modules.values():
+            child.evaluate_mode()
+        return self
+
+    # Reference-named aliases (AbstractModule.training()/evaluate()).
+    def set_training(self, is_training: bool = True) -> "Module":
+        return self.training_mode() if is_training else self.evaluate_mode()
+
+    def is_training(self) -> bool:
+        return self.training
+
+    def clone_module(self) -> "Module":
+        return copy.deepcopy(self)
+
+    # ----------------------------------------------------- parameter flatten
+    def parameters(self) -> List[jax.Array]:
+        """All trainable arrays, depth-first (reference returns
+        (weights, grads); grads have no stateful analogue here)."""
+        return jax.tree_util.tree_leaves(self.parameter_tree())
+
+    def get_parameters(self) -> Tuple[jax.Array, Callable[[jax.Array], Dict]]:
+        """Flat contiguous parameter vector + unravel fn.
+
+        Reference parity: ``Module.flatten`` / ``getParameters()``
+        (``nn/Module.scala:40-68``) builds one contiguous storage so the flat
+        all-reduce can exchange a single buffer. Under XLA the flat view is a
+        *functional* ravel: collectives operate on the pytree directly, but
+        the flat vector remains the contract for checkpoint compatibility and
+        the parameter-sharded optimizer update.
+        """
+        from jax.flatten_util import ravel_pytree
+        flat, unravel = ravel_pytree(self.parameter_tree())
+        return flat, unravel
+
+    def n_parameters(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+    def zero_grad_parameters(self) -> None:
+        """No-op: gradients are values returned by ``jax.grad``, never state."""
+
+    # ---------------------------------------------------------------- helpers
+    def set_name(self, name: str) -> "Module":
+        self.name = name
+        return self
+
+    def get_name(self) -> str:
+        return self.name
+
+    def find_module(self, name: str) -> Optional["Module"]:
+        """Lookup by name anywhere in the tree (reference ``apply(name)``)."""
+        for _, m in self.named_modules():
+            if m.name == name:
+                return m
+        return None
+
+    def rng_key(self) -> jax.Array:
+        """Fresh PRNG key from the bound stream (dropout, rrelu, ...)."""
+        return current_rng().next_key()
+
+    def __repr__(self) -> str:
+        child_repr = "".join(
+            f"\n  ({n}): " + repr(m).replace("\n", "\n  ")
+            for n, m in self._modules.items())
+        return f"{type(self).__name__}({child_repr}\n)" if child_repr else f"{type(self).__name__}()"
+
+    # ------------------------------------------------------------- inference
+    def predict(self, x: Activity) -> Activity:
+        was_training = self.training
+        self.evaluate_mode()
+        try:
+            params, buffers = self.parameter_tree(), self.buffer_tree()
+            out, _ = jit_apply(self)(params, buffers, x, training=False)
+            return out
+        finally:
+            self.set_training(was_training)
+
+    def predict_class(self, x: jax.Array) -> jax.Array:
+        """1-based class prediction (Torch label convention,
+        reference ``AbstractModule.predictClass``)."""
+        out = self.predict(x)
+        return jnp.argmax(out, axis=-1) + 1
+
+    def evaluate(self, dataset, methods):
+        """Batch evaluation (reference ``AbstractModule.evaluate`` →
+        ``optim/Evaluator.scala``)."""
+        from bigdl_tpu.optim.evaluator import Evaluator
+        return Evaluator(self).test(dataset, methods)
+
+
+class TensorModule(Module):
+    """Tensor→Tensor module marker (reference ``TensorModule``)."""
+
+
+# --------------------------------------------------------------------------
+# Functional view
+# --------------------------------------------------------------------------
+
+def functional_apply(module: Module,
+                     params: Dict[str, Any],
+                     buffers: Dict[str, Any],
+                     *inputs: Activity,
+                     training: bool = False,
+                     rng: Optional[jax.Array] = None,
+                     ) -> Tuple[Activity, Dict[str, Any]]:
+    """Run ``module.forward`` as a pure function of (params, buffers).
+
+    Returns ``(output, new_buffers)``. Safe to trace: the module's concrete
+    arrays are snapshotted before and restored after, so a ``jit`` trace never
+    leaves tracers behind in the module object.
+    """
+    old_params = module.parameter_tree()
+    old_buffers = module.buffer_tree()
+    old_training = module.training
+    token = _RNG_CTX.set(RngStream(rng))
+    try:
+        module.load_parameter_tree(params)
+        module.load_buffer_tree(buffers)
+        module.set_training(training)
+        out = module.forward(*inputs)
+        new_buffers = module.buffer_tree()
+    finally:
+        _RNG_CTX.reset(token)
+        module.load_parameter_tree(old_params)
+        module.load_buffer_tree(old_buffers)
+        module.set_training(old_training)
+        module.output = None  # don't retain tracers
+    return out, new_buffers
+
+
+def jit_apply(module: Module) -> Callable:
+    """Jitted pure forward: ``f(params, buffers, *inputs, training=...)``."""
+    def fn(params, buffers, *inputs, training=False, rng=None):
+        return functional_apply(module, params, buffers, *inputs,
+                                training=training, rng=rng)
+    return jax.jit(fn, static_argnames=("training",))

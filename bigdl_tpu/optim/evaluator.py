@@ -1,0 +1,83 @@
+"""Batch evaluation / prediction drivers (reference ``optim/Evaluator.scala:37``,
+``optim/Predictor.scala:34``).
+
+The reference broadcasts the model to executors and mapPartitions over the
+RDD; here a single jitted forward is reused across batches (and sharded over
+the mesh by ``parallel.distri_optimizer`` when one is active).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.dataset.base import AbstractDataSet, MiniBatch, Sample, SampleToBatch, LocalDataSet
+from bigdl_tpu.nn.module import Module, functional_apply
+from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+
+
+class Evaluator:
+    """reference ``optim/Evaluator.scala``."""
+
+    def __init__(self, model: Module, batch_size: int = 128):
+        self.model = model
+        self.batch_size = batch_size
+
+    def _as_batches(self, dataset):
+        if isinstance(dataset, AbstractDataSet):
+            it = dataset.data(train=False)
+            probe = next(iter([]), None)
+            return it
+        # list of Samples
+        ds = LocalDataSet(dataset) >> SampleToBatch(self.batch_size,
+                                                    drop_remainder=False)
+        return ds.data(train=False)
+
+    def test(self, dataset, v_methods: Sequence[ValidationMethod]
+             ) -> List[Tuple[ValidationResult, ValidationMethod]]:
+        model = self.model
+        params, buffers = model.parameter_tree(), model.buffer_tree()
+
+        @jax.jit
+        def fwd(p, b, x):
+            out, _ = functional_apply(model, p, b, x, training=False)
+            return out
+
+        results = [None] * len(v_methods)
+        for batch in self._as_batches(dataset):
+            if isinstance(batch, Sample):  # raw sample stream
+                batch = MiniBatch(batch.feature[None], jnp.atleast_1d(batch.label))
+            out = fwd(params, buffers, jnp.asarray(batch.data))
+            labels = jnp.asarray(batch.labels)
+            for i, m in enumerate(v_methods):
+                r = m.apply(out, labels)
+                results[i] = r if results[i] is None else results[i] + r
+        return [(r, m) for r, m in zip(results, v_methods)]
+
+
+class Predictor:
+    """reference ``optim/Predictor.scala``."""
+
+    def __init__(self, model: Module, batch_size: int = 128):
+        self.model = model
+        self.batch_size = batch_size
+
+    def predict(self, dataset) -> List:
+        model = self.model
+        params, buffers = model.parameter_tree(), model.buffer_tree()
+
+        @jax.jit
+        def fwd(p, b, x):
+            out, _ = functional_apply(model, p, b, x, training=False)
+            return out
+
+        outs = []
+        ev = Evaluator(model, self.batch_size)
+        for batch in ev._as_batches(dataset):
+            outs.append(fwd(params, buffers, jnp.asarray(batch.data)))
+        return outs
+
+    def predict_class(self, dataset) -> List:
+        return [jnp.argmax(o, axis=-1) + 1 for o in self.predict(dataset)]

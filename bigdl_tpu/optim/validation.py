@@ -1,0 +1,136 @@
+"""Validation metrics (reference ``optim/ValidationMethod.scala:33``:
+``Top1Accuracy:116``, ``Top5Accuracy:154``, ``Loss:248`` with mergeable
+``ValidationResult``s).
+
+Each method has a pure, jit-friendly core ``batch_result(output, target)``
+returning (correct_or_sum, count) so evaluation loops can run entirely on
+device and only merge scalars on the host.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.criterion import Criterion, ClassNLLCriterion
+
+
+class ValidationResult:
+    def result(self):
+        raise NotImplementedError
+
+    def __add__(self, other):
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    """(correct, count) pair (reference ``AccuracyResult``)."""
+
+    def __init__(self, correct: int, count: int):
+        self.correct, self.count = int(correct), int(count)
+
+    def result(self):
+        return (self.correct / max(1, self.count), self.count)
+
+    def __add__(self, other: "AccuracyResult"):
+        return AccuracyResult(self.correct + other.correct, self.count + other.count)
+
+    def __repr__(self):
+        acc, n = self.result()
+        return f"Accuracy(correct: {self.correct}, count: {n}, accuracy: {acc:.6f})"
+
+    def __eq__(self, other):
+        return (self.correct, self.count) == (other.correct, other.count)
+
+
+class LossResult(ValidationResult):
+    """(sum loss, count) pair (reference ``LossResult``)."""
+
+    def __init__(self, loss: float, count: int):
+        self.loss, self.count = float(loss), int(count)
+
+    def result(self):
+        return (self.loss / max(1, self.count), self.count)
+
+    def __add__(self, other: "LossResult"):
+        return LossResult(self.loss + other.loss, self.count + other.count)
+
+    def __repr__(self):
+        mean, n = self.result()
+        return f"Loss(sum: {self.loss:.4f}, count: {n}, mean: {mean:.6f})"
+
+
+class ValidationMethod:
+    """Base metric (reference ``ValidationMethod``)."""
+
+    name = "validation"
+
+    def batch_result(self, output, target):
+        """Pure device-side (value, count) for one batch."""
+        raise NotImplementedError
+
+    def to_result(self, value, count) -> ValidationResult:
+        raise NotImplementedError
+
+    def apply(self, output, target) -> ValidationResult:
+        v, c = self.batch_result(output, target)
+        return self.to_result(float(v), int(c))
+
+    def __call__(self, output, target) -> ValidationResult:
+        return self.apply(output, target)
+
+    def __repr__(self):
+        return self.name
+
+
+def _topk_correct(output, target, k: int):
+    # output (N, C) scores; target (N,) 1-based labels.
+    if output.ndim == 1:
+        output = output[None, :]
+        target = jnp.reshape(target, (1,))
+    n, c = output.shape
+    k = min(k, c)
+    idx = jnp.argsort(output, axis=1)[:, ::-1][:, :k]  # top-k, 0-based
+    hits = jnp.any(idx == (target.astype(jnp.int32) - 1)[:, None], axis=1)
+    return jnp.sum(hits), n
+
+
+class Top1Accuracy(ValidationMethod):
+    """reference ``ValidationMethod.scala:116``."""
+
+    name = "Top1Accuracy"
+
+    def batch_result(self, output, target):
+        return _topk_correct(output, target, 1)
+
+    def to_result(self, value, count):
+        return AccuracyResult(value, count)
+
+
+class Top5Accuracy(ValidationMethod):
+    """reference ``ValidationMethod.scala:154``."""
+
+    name = "Top5Accuracy"
+
+    def batch_result(self, output, target):
+        return _topk_correct(output, target, 5)
+
+    def to_result(self, value, count):
+        return AccuracyResult(value, count)
+
+
+class Loss(ValidationMethod):
+    """Criterion-as-metric (reference ``ValidationMethod.scala:248``)."""
+
+    name = "Loss"
+
+    def __init__(self, criterion: Optional[Criterion] = None):
+        self.criterion = criterion or ClassNLLCriterion()
+
+    def batch_result(self, output, target):
+        n = output.shape[0] if output.ndim > 1 else 1
+        return self.criterion.apply(output, target) * n, n
+
+    def to_result(self, value, count):
+        return LossResult(value, count)

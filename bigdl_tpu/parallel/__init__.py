@@ -1,0 +1,12 @@
+"""bigdl_tpu.parallel — the distributed plane (reference ``$B/parameters/`` +
+``DistriOptimizer``), rebuilt as mesh sharding + XLA collectives.
+
+The reference's communication backend is a parameter-sharded, fp16-compressed
+all-reduce over Spark BlockManager (``parameters/AllReduceParameter.scala``).
+Here every distributed strategy is a sharding layout over one
+``jax.sharding.Mesh`` and the collectives are XLA's (psum / all_gather /
+reduce_scatter / ppermute riding ICI) — plus new capabilities the reference
+lacks: tensor/pipeline/sequence(ring-attention)/expert parallelism.
+"""
+
+from bigdl_tpu.parallel.mesh import MeshTopology

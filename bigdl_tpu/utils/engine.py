@@ -1,0 +1,125 @@
+"""Engine: process-global accelerator topology, the TPU analogue of
+``utils/Engine.scala:32``.
+
+The reference Engine parses Spark configs into (nExecutors x coresPerExecutor)
+and owns two JVM thread pools that fan work out over cores. On TPU the unit of
+parallelism is a *chip on a mesh*, not a core in a thread pool: XLA already
+parallelises within a chip (MXU/VPU lanes), so ``Engine.model``-style intra-op
+pools are unnecessary. What remains Engine's job:
+
+- device discovery (``jax.devices()``), local vs. global counts (multi-host),
+- construction of the default `jax.sharding.Mesh` used by DistriOptimizer,
+- a small host-side IO thread pool (data pipeline prefetch — the one place
+  host threads still matter, replacing ``Engine.default``),
+- environment sanity checks (the analogue of ``Engine.checkSparkContext``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class _EngineState:
+    def __init__(self) -> None:
+        self.initialized = False
+        self.node_number = 1
+        self.core_number = 1
+        self._devices = None
+        self._mesh = None
+        self._io_pool: Optional[ThreadPoolExecutor] = None
+        self._lock = threading.Lock()
+
+
+_state = _EngineState()
+
+
+class Engine:
+    """Process-global topology singleton (reference ``utils/Engine.scala``)."""
+
+    @staticmethod
+    def init(node_number: Optional[int] = None,
+             core_number: Optional[int] = None,
+             devices: Optional[Sequence] = None) -> None:
+        """Initialise topology.
+
+        ``node_number``/``core_number`` retain the reference's names
+        (``Engine.init`` at ``utils/Engine.scala:100``) but map to hosts and
+        local chips. With no arguments, discovers the JAX runtime topology.
+        """
+        import jax
+
+        with _state._lock:
+            _state._devices = list(devices) if devices is not None else jax.devices()
+            _state.node_number = node_number if node_number is not None else jax.process_count()
+            _state.core_number = (core_number if core_number is not None
+                                  else max(1, len(_state._devices) // max(1, _state.node_number)))
+            _state._mesh = None  # rebuilt lazily against the new device set
+            _state.initialized = True
+
+    @staticmethod
+    def is_initialized() -> bool:
+        return _state.initialized
+
+    @staticmethod
+    def node_number() -> int:
+        Engine._ensure()
+        return _state.node_number
+
+    @staticmethod
+    def core_number() -> int:
+        Engine._ensure()
+        return _state.core_number
+
+    @staticmethod
+    def devices():
+        Engine._ensure()
+        return list(_state._devices)
+
+    @staticmethod
+    def device_count() -> int:
+        return len(Engine.devices())
+
+    @staticmethod
+    def default_mesh(axis_name: str = "data"):
+        """The 1-D data-parallel mesh over all devices.
+
+        This is the TPU-native stand-in for the reference's implicit
+        "one partition per executor" topology (``AllReduceParameter`` slice
+        ownership): every chip holds a full replica, gradients are reduced by
+        an XLA ``psum`` riding ICI instead of BlockManager fetches.
+        """
+        from jax.sharding import Mesh
+
+        Engine._ensure()
+        if _state._mesh is None or _state._mesh.axis_names != (axis_name,):
+            devs = np.array(Engine.devices())
+            _state._mesh = Mesh(devs, (axis_name,))
+        return _state._mesh
+
+    @staticmethod
+    def io_pool() -> ThreadPoolExecutor:
+        """Host-side IO/prefetch pool (descendant of ``Engine.default``,
+        ``utils/Engine.scala:236-241`` — here only for the data pipeline)."""
+        Engine._ensure()
+        if _state._io_pool is None:
+            n = int(os.environ.get("BIGDL_TPU_IO_THREADS", str(min(16, os.cpu_count() or 4))))
+            _state._io_pool = ThreadPoolExecutor(max_workers=n, thread_name_prefix="bigdl-io")
+        return _state._io_pool
+
+    @staticmethod
+    def reset() -> None:
+        """Forget topology (test hook, analogue of re-running Engine.init)."""
+        with _state._lock:
+            if _state._io_pool is not None:
+                _state._io_pool.shutdown(wait=False)
+            _state.__init__()
+
+    @staticmethod
+    def _ensure() -> None:
+        if not _state.initialized:
+            Engine.init()

@@ -1,0 +1,32 @@
+"""Test config: force an 8-device virtual CPU platform BEFORE jax imports.
+
+Mirrors the reference's trick of simulating a 4-node cluster inside one JVM
+(``DistriOptimizerSpec.scala:40-42`` with ``Engine.init(4, 4, true)``): here
+``xla_force_host_platform_device_count=8`` fakes an 8-chip mesh on CPU so
+every sharding/collective path compiles and runs without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Plugins may have imported jax before this conftest ran, freezing the
+# platform choice from the ambient env — override through the live config.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_engine_and_seed():
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.rng import manual_seed
+    Engine.reset()
+    manual_seed(1)
+    yield
+    Engine.reset()

@@ -1,0 +1,128 @@
+"""Optimization-method and trigger tests (reference ``$T/optim/``:
+``SGDSpec``, ``AdamSpec`` etc. validate convergence on small problems;
+``TriggerSpec`` behavior).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.optim import (SGD, Adadelta, Adagrad, Adam, Adamax, LBFGS,
+                             RMSprop, Trigger)
+from bigdl_tpu.optim.methods import Default, EpochSchedule, EpochStep, Poly, Regime, Step, Warmup
+from bigdl_tpu.utils.table import T
+
+
+def rosenbrock_ish(x):
+    """Simple convex quadratic: min at (1, 2)."""
+    return (x[0] - 1.0) ** 2 + 2.0 * (x[1] - 2.0) ** 2
+
+
+@pytest.mark.parametrize("method,steps,tol", [
+    (SGD(learningrate=0.1), 200, 1e-2),
+    (SGD(learningrate=0.05, momentum=0.9), 200, 1e-2),
+    (SGD(learningrate=0.05, momentum=0.9, dampening=0.0, nesterov=True), 200, 1e-2),
+    (Adam(learningrate=0.1), 400, 1e-2),
+    (Adagrad(learningrate=0.5), 400, 5e-2),
+    (Adamax(learningrate=0.2), 400, 1e-2),
+    (RMSprop(learningrate=0.05), 400, 5e-2),
+    (Adadelta(decayrate=0.9, epsilon=1e-4), 3000, 2e-1),
+])
+def test_converges_on_quadratic(method, steps, tol):
+    x = jnp.asarray([0.0, 0.0])
+    state = method.init_state(x)
+    grad_fn = jax.grad(rosenbrock_ish)
+
+    @jax.jit
+    def step(x, state):
+        return method.update(grad_fn(x), state, x)
+
+    for _ in range(steps):
+        x, state = step(x, state)
+    assert float(rosenbrock_ish(x)) < tol, x
+
+
+def test_lbfgs_quadratic():
+    def feval(x):
+        return rosenbrock_ish(x), jax.grad(rosenbrock_ish)(x)
+
+    x, losses = LBFGS(max_iter=30).optimize(feval, jnp.asarray([0.0, 0.0]))
+    assert losses[-1] < 1e-4
+
+
+class TestSchedules:
+    def test_default_decay(self):
+        sgd = SGD(learningrate=1.0, learningrate_decay=0.1)
+        s = sgd.init_state(jnp.zeros(2))
+        s["evalCounter"] = jnp.asarray(10)
+        np.testing.assert_allclose(float(sgd.current_rate(s)), 1.0 / 2.0)
+
+    def test_poly(self):
+        sgd = SGD(learningrate=1.0, learningrate_schedule=Poly(2.0, 100))
+        s = sgd.init_state(jnp.zeros(2))
+        s["evalCounter"] = jnp.asarray(50)
+        np.testing.assert_allclose(float(sgd.current_rate(s)), 0.25)
+
+    def test_step(self):
+        sgd = SGD(learningrate=1.0, learningrate_schedule=Step(10, 0.5))
+        s = sgd.init_state(jnp.zeros(2))
+        s["evalCounter"] = jnp.asarray(25)
+        np.testing.assert_allclose(float(sgd.current_rate(s)), 0.25)
+
+    def test_epoch_step(self):
+        sgd = SGD(learningrate=1.0, learningrate_schedule=EpochStep(2, 0.1))
+        s = sgd.init_state(jnp.zeros(2))
+        s["epoch"] = jnp.asarray(5)
+        np.testing.assert_allclose(float(sgd.current_rate(s)), 0.01, rtol=1e-5)
+
+    def test_regime_schedule(self):
+        sched = EpochSchedule([
+            Regime(1, 3, T(learningRate=0.1)),
+            Regime(4, 7, T(learningRate=0.01)),
+            Regime(8, 100, T(learningRate=0.001)),
+        ])
+        sgd = SGD(learningrate=0.1, learningrate_schedule=sched)
+        s = sgd.init_state(jnp.zeros(2))
+        for epoch, expect in [(2, 0.1), (5, 0.01), (50, 0.001)]:
+            s["epoch"] = jnp.asarray(epoch)
+            np.testing.assert_allclose(float(sgd.current_rate(s)), expect, rtol=1e-6)
+
+    def test_warmup(self):
+        sgd = SGD(learningrate=1.0, learningrate_schedule=Warmup(10, Default()))
+        s = sgd.init_state(jnp.zeros(2))
+        s["evalCounter"] = jnp.asarray(4)
+        np.testing.assert_allclose(float(sgd.current_rate(s)), 0.5)
+        s["evalCounter"] = jnp.asarray(20)
+        np.testing.assert_allclose(float(sgd.current_rate(s)), 1.0)
+
+
+class TestTriggers:
+    def test_max_epoch_iteration(self):
+        assert Trigger.max_epoch(5)(T(epoch=6, neval=1))
+        assert not Trigger.max_epoch(5)(T(epoch=5, neval=1))
+        assert Trigger.max_iteration(10)(T(epoch=1, neval=11))
+
+    def test_every_epoch_fires_once(self):
+        t = Trigger.every_epoch()
+        assert t(T(epoch=1))
+        assert not t(T(epoch=1))
+        assert t(T(epoch=2))
+
+    def test_several_iteration(self):
+        t = Trigger.several_iteration(5)
+        assert t(T(neval=10))
+        assert not t(T(neval=11))
+
+    def test_combinators(self):
+        t = Trigger.and_(Trigger.max_epoch(2), Trigger.max_iteration(3))
+        assert t(T(epoch=3, neval=4))
+        assert not t(T(epoch=3, neval=2))
+
+    def test_weight_decay_in_sgd(self):
+        # wd pulls params toward zero with zero gradient
+        sgd = SGD(learningrate=0.1, weightdecay=0.5)
+        x = jnp.asarray([1.0])
+        s = sgd.init_state(x)
+        x2, _ = sgd.update(jnp.zeros(1), s, x)
+        np.testing.assert_allclose(float(x2[0]), 1.0 - 0.1 * 0.5)
