@@ -66,12 +66,15 @@ class Concat(Container):
         self.dimension = dimension
 
     def _axis(self, out):
-        # Translate Torch NCHW dim to channels-last axis.
-        if out.ndim == 4 and self.dimension == 1:
-            return -1          # channels
-        if out.ndim == 2:
-            return self.dimension  # (N, F): dim 1 -> axis 1
-        return self.dimension
+        # Translate the reference's 1-based non-batch NCHW dim to our
+        # channels-last axis: batched (N,H,W,C): C->3, H->1, W->2;
+        # unbatched (H,W,C): C->2, H->0, W->1; (N,F): dim 1 -> axis 1.
+        d = self.dimension
+        if out.ndim == 4:
+            return {1: 3, 2: 1, 3: 2}[d]
+        if out.ndim == 3:
+            return {1: 2, 2: 0, 3: 1}[d]
+        return d
 
     def update_output(self, input):
         outs = [m.forward(input) for m in self._ordered]

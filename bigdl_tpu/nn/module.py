@@ -224,6 +224,11 @@ class Module:
     def clone_module(self) -> "Module":
         return copy.deepcopy(self)
 
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_jit_forward", None)  # jit wrappers don't serialize/deepcopy
+        return d
+
     # ----------------------------------------------------- parameter flatten
     def parameters(self) -> List[jax.Array]:
         """All trainable arrays, depth-first (reference returns
@@ -276,12 +281,20 @@ class Module:
         return f"{type(self).__name__}({child_repr}\n)" if child_repr else f"{type(self).__name__}()"
 
     # ------------------------------------------------------------- inference
+    def _jitted_forward(self):
+        """Cached jitted pure forward — one compile per module instance."""
+        fn = self.__dict__.get("_jit_forward")
+        if fn is None:
+            fn = jit_apply(self)
+            self.__dict__["_jit_forward"] = fn
+        return fn
+
     def predict(self, x: Activity) -> Activity:
         was_training = self.training
         self.evaluate_mode()
         try:
             params, buffers = self.parameter_tree(), self.buffer_tree()
-            out, _ = jit_apply(self)(params, buffers, x, training=False)
+            out, _ = self._jitted_forward()(params, buffers, x, training=False)
             return out
         finally:
             self.set_training(was_training)
@@ -335,7 +348,9 @@ def functional_apply(module: Module,
         module.load_parameter_tree(old_params)
         module.load_buffer_tree(old_buffers)
         module.set_training(old_training)
-        module.output = None  # don't retain tracers
+        for m in module.modules():  # don't retain tracers anywhere in the tree
+            m.output = None
+            m.grad_input = None
     return out, new_buffers
 
 

@@ -4,18 +4,46 @@
 The reference broadcasts the model to executors and mapPartitions over the
 RDD; here a single jitted forward is reused across batches (and sharded over
 the mesh by ``parallel.distri_optimizer`` when one is active).
+``evaluate_batches`` is the one batch-eval/merge loop — Evaluator, Predictor
+and in-training validation all delegate to it.
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from bigdl_tpu.dataset.base import AbstractDataSet, MiniBatch, Sample, SampleToBatch, LocalDataSet
+from bigdl_tpu.dataset.base import (AbstractDataSet, LocalDataSet, MiniBatch,
+                                    Sample, SampleToBatch)
 from bigdl_tpu.nn.module import Module, functional_apply
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+
+
+def _as_minibatch(item) -> MiniBatch:
+    if isinstance(item, Sample):
+        return MiniBatch(item.feature[None], jnp.atleast_1d(item.label))
+    return item
+
+
+def evaluate_batches(fwd: Callable, params, buffers,
+                     batches: Iterable,
+                     v_methods: Sequence[ValidationMethod],
+                     ) -> Tuple[List[Optional[ValidationResult]], int]:
+    """Run ``fwd(params, buffers, data)`` over batches, merging each method's
+    ValidationResults. Returns (results, record_count)."""
+    results: List[Optional[ValidationResult]] = [None] * len(v_methods)
+    count = 0
+    for item in batches:
+        batch = _as_minibatch(item)
+        out = fwd(params, buffers, jnp.asarray(batch.data))
+        labels = jnp.asarray(batch.labels)
+        for i, m in enumerate(v_methods):
+            r = m.apply(out, labels)
+            results[i] = r if results[i] is None else results[i] + r
+        count += batch.size()
+    return results, count
 
 
 class Evaluator:
@@ -27,33 +55,27 @@ class Evaluator:
 
     def _as_batches(self, dataset):
         if isinstance(dataset, AbstractDataSet):
-            it = dataset.data(train=False)
-            probe = next(iter([]), None)
-            return it
-        # list of Samples
+            return dataset.data(train=False)
+        # raw list of Samples: batch them (reference uses SampleToBatch(4/p))
         ds = LocalDataSet(dataset) >> SampleToBatch(self.batch_size,
                                                     drop_remainder=False)
         return ds.data(train=False)
 
-    def test(self, dataset, v_methods: Sequence[ValidationMethod]
-             ) -> List[Tuple[ValidationResult, ValidationMethod]]:
+    def _fwd(self):
         model = self.model
-        params, buffers = model.parameter_tree(), model.buffer_tree()
 
         @jax.jit
         def fwd(p, b, x):
             out, _ = functional_apply(model, p, b, x, training=False)
             return out
 
-        results = [None] * len(v_methods)
-        for batch in self._as_batches(dataset):
-            if isinstance(batch, Sample):  # raw sample stream
-                batch = MiniBatch(batch.feature[None], jnp.atleast_1d(batch.label))
-            out = fwd(params, buffers, jnp.asarray(batch.data))
-            labels = jnp.asarray(batch.labels)
-            for i, m in enumerate(v_methods):
-                r = m.apply(out, labels)
-                results[i] = r if results[i] is None else results[i] + r
+        return fwd
+
+    def test(self, dataset, v_methods: Sequence[ValidationMethod]
+             ) -> List[Tuple[ValidationResult, ValidationMethod]]:
+        params, buffers = self.model.parameter_tree(), self.model.buffer_tree()
+        results, _ = evaluate_batches(self._fwd(), params, buffers,
+                                      self._as_batches(dataset), v_methods)
         return [(r, m) for r, m in zip(results, v_methods)]
 
 
@@ -65,17 +87,12 @@ class Predictor:
         self.batch_size = batch_size
 
     def predict(self, dataset) -> List:
-        model = self.model
-        params, buffers = model.parameter_tree(), model.buffer_tree()
-
-        @jax.jit
-        def fwd(p, b, x):
-            out, _ = functional_apply(model, p, b, x, training=False)
-            return out
-
+        ev = Evaluator(self.model, self.batch_size)
+        fwd = ev._fwd()
+        params, buffers = self.model.parameter_tree(), self.model.buffer_tree()
         outs = []
-        ev = Evaluator(model, self.batch_size)
-        for batch in ev._as_batches(dataset):
+        for item in ev._as_batches(dataset):
+            batch = _as_minibatch(item)
             outs.append(fwd(params, buffers, jnp.asarray(batch.data)))
         return outs
 

@@ -218,7 +218,8 @@ class LocalOptimizer(Optimizer):
         rng = RandomGenerator.RNG()
         wall_start = time.time()
 
-        while not self.end_when(driver_state):
+        stop = False
+        while not stop and not self.end_when(driver_state):
             self.dataset.shuffle()
             epoch = int(driver_state["epoch"])
             opt_state["epoch"] = jnp.asarray(epoch, jnp.int32)
@@ -254,6 +255,9 @@ class LocalOptimizer(Optimizer):
                 driver_state["neval"] = neval + 1
                 self._hooks(params, buffers, opt_state, driver_state, fwd,
                             epoch_done=False)
+                if self.end_when(driver_state):  # iteration/loss-based stops
+                    stop = True
+                    break
                 t_data = time.time()
             self.metrics.add("data wait time", data_wait)
             logger.info("[Epoch %d] Epoch finished. Wall clock time is %.1f ms (%d records)",
@@ -280,25 +284,22 @@ class LocalOptimizer(Optimizer):
     def _validate(self, params, buffers, fwd, driver_state) -> None:
         if self.validation_dataset is None:
             return
+        from bigdl_tpu.optim.evaluator import evaluate_batches
         t0 = time.time()
-        results = [None] * len(self.validation_methods)
-        count = 0
-        for batch in self.validation_dataset.data(train=False):
-            out = fwd(params, buffers, jnp.asarray(batch.data))
-            labels = jnp.asarray(batch.labels)
-            for i, m in enumerate(self.validation_methods):
-                r = m.apply(out, labels)
-                results[i] = r if results[i] is None else results[i] + r
-            count += batch.size()
+        results, count = evaluate_batches(
+            fwd, params, buffers, self.validation_dataset.data(train=False),
+            self.validation_methods)
         elapsed = time.time() - t0
         logger.info("[Validation] %d records in %.3fs. Throughput is %.1f records/s",
                     count, elapsed, count / max(elapsed, 1e-9))
-        for m, r in zip(self.validation_methods, results):
+        for i, (m, r) in enumerate(zip(self.validation_methods, results)):
             if r is None:
                 continue
             logger.info("%s is %s", m.name, r)
             value = r.result()[0]
-            driver_state["score"] = value
+            if i == 0:
+                # 'score' (used by Trigger.max_score) tracks the FIRST method.
+                driver_state["score"] = value
             if self.validation_summary is not None:
                 self.validation_summary.add_scalar(m.name, value,
                                                    int(driver_state["neval"]) - 1)

@@ -22,11 +22,15 @@ class Trigger:
     # -- factories (reference Trigger object methods) -----------------------
     @staticmethod
     def every_epoch() -> "Trigger":
-        """Fires when the epoch counter advances past the recorded one."""
-        box = {"last": 0}
+        """Fires at each epoch *boundary* (when the epoch counter advances
+        past the first value seen — so never mid-first-epoch)."""
+        box = {"last": None}
 
         def fn(state: Table) -> bool:
             e = int(state["epoch"])
+            if box["last"] is None:
+                box["last"] = e
+                return False
             if e > box["last"]:
                 box["last"] = e
                 return True

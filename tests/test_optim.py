@@ -105,9 +105,11 @@ class TestTriggers:
 
     def test_every_epoch_fires_once(self):
         t = Trigger.every_epoch()
-        assert t(T(epoch=1))
+        assert not t(T(epoch=1))  # mid-first-epoch: no boundary crossed yet
         assert not t(T(epoch=1))
-        assert t(T(epoch=2))
+        assert t(T(epoch=2))      # fires exactly once at the boundary
+        assert not t(T(epoch=2))
+        assert t(T(epoch=3))
 
     def test_several_iteration(self):
         t = Trigger.several_iteration(5)
