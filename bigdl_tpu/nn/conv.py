@@ -23,6 +23,7 @@ import numpy as np
 
 from bigdl_tpu.nn import initialization as init
 from bigdl_tpu.nn.module import TensorModule
+from bigdl_tpu.ops.precision import match_compute
 
 _DN_2D = ("NHWC", "HWIO", "NHWC")
 _DN_3D = ("NDHWC", "DHWIO", "NDHWC")
@@ -77,6 +78,7 @@ class SpatialConvolution(TensorModule):
         squeeze = input.ndim == 3
         if squeeze:  # unbatched (H, W, C)
             input = input[None]
+        input = match_compute(input, self.weight)
         out = jax.lax.conv_general_dilated(
             input, self.weight,
             window_strides=(self.stride_h, self.stride_w),

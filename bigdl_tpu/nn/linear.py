@@ -16,6 +16,7 @@ import numpy as np
 
 from bigdl_tpu.nn import initialization as init
 from bigdl_tpu.nn.module import TensorModule, Module
+from bigdl_tpu.ops.precision import match_compute
 from bigdl_tpu.utils.rng import RandomGenerator
 
 
@@ -49,7 +50,7 @@ class Linear(TensorModule):
                 init.default_init((self.output_size,), self.input_size))
 
     def update_output(self, input):
-        y = jnp.matmul(input, self.weight.T)
+        y = jnp.matmul(match_compute(input, self.weight), self.weight.T)
         if self.with_bias:
             y = y + self.bias
         return y

@@ -98,6 +98,7 @@ class Module:
 
     def __setattr__(self, name: str, value: Any) -> None:
         if isinstance(value, Module):
+            self.__dict__.pop(name, None)  # module registry wins over plain attr
             self._modules[name] = value
         elif name in self._parameters:
             self._parameters[name] = value

@@ -98,6 +98,8 @@ class Optimizer:
         self.state: Table = T()
         self.metrics = Metrics()
         self._resume_from: Optional[Tuple[str, str]] = None
+        from bigdl_tpu.ops.precision import DtypePolicy
+        self.precision = DtypePolicy.fp32()
 
     # ---------------------------------------------------------------- builder
     def set_validation(self, trigger: Trigger, dataset: AbstractDataSet,
@@ -136,6 +138,21 @@ class Optimizer:
         self.end_when = end_when
         return self
 
+    def set_precision(self, policy) -> "Optimizer":
+        """'bf16' / 'fp32' or a DtypePolicy: bf16 compute with fp32 master
+        params (the MXU-native recipe; see ``ops/precision.py``)."""
+        from bigdl_tpu.ops.precision import DtypePolicy
+        if isinstance(policy, str):
+            try:
+                policy = {"bf16": DtypePolicy.bf16,
+                          "fp32": DtypePolicy.fp32}[policy]()
+            except KeyError:
+                raise ValueError(
+                    f"unknown precision {policy!r}; use 'bf16', 'fp32', "
+                    f"or a DtypePolicy") from None
+        self.precision = policy
+        return self
+
     def resume(self, model_path: str, state_path: str) -> "Optimizer":
         """Continue from snapshot files (reference examples' --model/--state)."""
         self._resume_from = (model_path, state_path)
@@ -172,12 +189,17 @@ class LocalOptimizer(Optimizer):
     def _build_step(self) -> Callable:
         model, criterion, optim = self.model, self.criterion, self.optim_method
         reg_pairs = _regularizer_pairs(model)
+        policy = self.precision
 
         def step(params, buffers, opt_state, rng, data, labels):
             def loss_fn(p):
-                out, new_buf = functional_apply(model, p, buffers, data,
+                p_c = policy.cast_params_for_compute(p)
+                out, new_buf = functional_apply(model, p_c, buffers,
+                                                data,
                                                 training=True, rng=rng)
-                loss = criterion.apply(out, labels)
+                loss = criterion.apply(out, labels).astype(jnp.float32)
+                from bigdl_tpu.ops.precision import cast_tree
+                new_buf = cast_tree(new_buf, jnp.float32)
                 return loss + _reg_loss(p, reg_pairs), (new_buf, loss)
 
             grads, (new_buf, loss) = jax.grad(loss_fn, has_aux=True)(params)

@@ -81,12 +81,17 @@ class DistriOptimizer(LocalOptimizer):
         model, criterion, optim = self.model, self.criterion, self.optim_method
         reg_pairs = _regularizer_pairs(model)
         compress = self.compress_gradients
+        policy = self.precision
 
         def step(params, buffers, opt_state, rng, data, labels):
             def loss_fn(p):
-                out, new_buf = functional_apply(model, p, buffers, data,
+                from bigdl_tpu.ops.precision import cast_tree
+                p_c = policy.cast_params_for_compute(p)
+                out, new_buf = functional_apply(model, p_c, buffers,
+                                                data,
                                                 training=True, rng=rng)
-                loss = criterion.apply(out, labels)
+                loss = criterion.apply(out, labels).astype(jnp.float32)
+                new_buf = cast_tree(new_buf, jnp.float32)
                 return loss + _reg_loss(p, reg_pairs), (new_buf, loss)
 
             grads, (new_buf, loss) = jax.grad(loss_fn, has_aux=True)(params)
@@ -130,14 +135,20 @@ class DistriOptimizer(LocalOptimizer):
             else P(),
             opt_template)
 
+        policy = self.precision
+
         def spmd_step(flat_params, buffers, opt_state, rng, data, labels):
             # flat_params: full replicated flat vector (post all-gather state).
             params = unravel(flat_params[:n])
 
             def loss_fn(p):
-                out, new_buf = functional_apply(model, p, buffers, data,
+                from bigdl_tpu.ops.precision import cast_tree
+                p_c = policy.cast_params_for_compute(p)
+                out, new_buf = functional_apply(model, p_c, buffers,
+                                                data,
                                                 training=True, rng=rng)
-                loss = criterion.apply(out, labels)
+                loss = criterion.apply(out, labels).astype(jnp.float32)
+                new_buf = cast_tree(new_buf, jnp.float32)
                 return loss + _reg_loss(p, reg_pairs), (new_buf, loss)
 
             grads, (new_buf, loss) = jax.grad(loss_fn, has_aux=True)(params)

@@ -10,7 +10,7 @@ boundary.
 
 import numpy as np
 import pytest
-import torch
+torch = __import__("pytest").importorskip("torch")
 import torch.nn.functional as F
 
 import jax.numpy as jnp

@@ -1,0 +1,142 @@
+"""Model-zoo shape/smoke tests (reference ``$T/models/``: build each net,
+run a forward/backward, check shapes & a few training steps).
+Full-size ImageNet models forward on tiny batches to keep CPU CI fast.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu as bt
+from bigdl_tpu import nn
+from bigdl_tpu.models import autoencoder, inception, lenet, resnet, rnn, vgg
+
+
+def fwd(model, x, training=False):
+    out, _ = nn.functional_apply(model, model.parameter_tree(),
+                                 model.buffer_tree(), x, training=training,
+                                 rng=jax.random.key(0))
+    return out
+
+
+class TestShapes:
+    def test_lenet(self):
+        out = fwd(lenet.build(10), jnp.zeros((2, 28, 28, 1)))
+        assert out.shape == (2, 10)
+
+    def test_lenet_graph(self):
+        out = fwd(lenet.graph(10), jnp.zeros((2, 28, 28, 1)))
+        assert out.shape == (2, 10)
+
+    def test_vgg_cifar(self):
+        out = fwd(vgg.build(10), jnp.zeros((2, 32, 32, 3)))
+        assert out.shape == (2, 10)
+
+    def test_resnet_cifar(self):
+        out = fwd(resnet.build_cifar(10, depth=20), jnp.zeros((2, 32, 32, 3)))
+        assert out.shape == (2, 10)
+
+    @pytest.mark.parametrize("depth", [18, 50])
+    def test_resnet_imagenet(self, depth):
+        model = resnet.build(1000, depth=depth)
+        out = fwd(model, jnp.zeros((1, 224, 224, 3)))
+        assert out.shape == (1, 1000)
+
+    def test_resnet50_param_count(self):
+        # canonical ResNet-50 parameter count ≈ 25.56M
+        n = resnet.build(1000, 50).n_parameters()
+        assert 25_000_000 < n < 26_100_000, n
+
+    def test_inception_v1(self):
+        out = fwd(inception.build(1000), jnp.zeros((1, 224, 224, 3)))
+        assert out.shape == (1, 1000)
+
+    def test_autoencoder(self):
+        out = fwd(autoencoder.build(32), jnp.zeros((2, 28, 28, 1)))
+        assert out.shape == (2, 784)
+
+    def test_simple_rnn(self):
+        model = rnn.build(input_size=40, hidden_size=20, output_size=40)
+        out = fwd(model, jnp.zeros((2, 7, 40)))
+        assert out.shape == (2, 7, 40)
+
+    def test_text_classifier(self):
+        model = rnn.build_classifier(100, 16, 32, 5)
+        idx = jnp.ones((3, 11), jnp.float32)
+        out = fwd(model, idx)
+        assert out.shape == (3, 5)
+
+
+class TestRecurrentNumerics:
+    def test_lstm_matches_torch(self):
+        torch = __import__("pytest").importorskip("torch")
+        n, t, f, h = 3, 5, 4, 6
+        cell = nn.LSTM(f, h)
+        rec = nn.Recurrent().add(cell)
+        x = np.random.randn(n, t, f).astype(np.float32)
+
+        ref = torch.nn.LSTM(f, h, batch_first=True)
+        with torch.no_grad():
+            ref.weight_ih_l0.copy_(torch.from_numpy(np.asarray(cell.w_ih)))
+            ref.weight_hh_l0.copy_(torch.from_numpy(np.asarray(cell.w_hh)))
+            ref.bias_ih_l0.copy_(torch.from_numpy(np.asarray(cell.bias)))
+            ref.bias_hh_l0.zero_()
+        out_ref, _ = ref(torch.from_numpy(x))
+        out = rec.forward(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), out_ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_gru_matches_torch(self):
+        torch = __import__("pytest").importorskip("torch")
+        n, t, f, h = 2, 4, 3, 5
+        cell = nn.GRU(f, h)
+        rec = nn.Recurrent().add(cell)
+        x = np.random.randn(n, t, f).astype(np.float32)
+        ref = torch.nn.GRU(f, h, batch_first=True)
+        with torch.no_grad():
+            ref.weight_ih_l0.copy_(torch.from_numpy(np.asarray(cell.w_ih)))
+            ref.weight_hh_l0.copy_(torch.from_numpy(np.asarray(cell.w_hh)))
+            ref.bias_ih_l0.copy_(torch.from_numpy(np.asarray(cell.bias_ih)))
+            ref.bias_hh_l0.copy_(torch.from_numpy(np.asarray(cell.bias_hh)))
+        out_ref, _ = ref(torch.from_numpy(x))
+        out = rec.forward(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), out_ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_birecurrent_shapes(self):
+        model = nn.BiRecurrent().add(nn.LSTM(4, 6))
+        out = model.forward(jnp.zeros((2, 5, 4)))
+        assert out.shape == (2, 5, 12)
+
+    def test_recurrent_decoder(self):
+        dec = nn.RecurrentDecoder(seq_length=4).add(nn.LSTM(8, 8))
+        out = dec.forward(jnp.zeros((2, 8)))
+        assert out.shape == (2, 4, 8)
+
+    def test_rnn_trains(self):
+        """A tiny RNN language model must fit a repeating sequence."""
+        bt.utils.manual_seed(5)
+        v, t = 8, 6
+        model = rnn.build(v, 16, v)
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        seq = np.array([(i % v) for i in range(t + 1)], np.int64)
+        x = np.zeros((1, t, v), np.float32)
+        x[0, np.arange(t), seq[:-1]] = 1.0
+        y = (seq[1:] + 1).astype(np.float32)[None]  # 1-based next-token
+        params = model.parameter_tree()
+
+        def loss_fn(p):
+            out, _ = nn.functional_apply(model, p, {}, jnp.asarray(x),
+                                         training=True)
+            return crit.apply(out, jnp.asarray(y))
+
+        from bigdl_tpu.optim import Adam
+        opt = Adam(learningrate=0.05)
+        state = opt.init_state(params)
+        step = jax.jit(lambda p, s: opt.update(jax.grad(loss_fn)(p), s, p))
+        l0 = float(loss_fn(params))
+        for _ in range(60):
+            params, state = step(params, state)
+        l1 = float(loss_fn(params))
+        assert l1 < l0 * 0.3, (l0, l1)
