@@ -62,3 +62,7 @@ from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTM, LSTMPeephole, GRU, Recurrent, RecurrentDecoder,
     BiRecurrent, TimeDistributed,
 )
+from bigdl_tpu.nn.attention import (
+    LayerNorm, MultiHeadAttention, PositionalEncoding,
+    TransformerEncoderLayer, TransformerEncoder,
+)

@@ -1,0 +1,274 @@
+"""Attention stack tests: torch oracle for MHA/LayerNorm, internal
+consistency for the blockwise (flash) formulation and the Pallas kernel in
+interpret mode. New capability — no reference analogue (SURVEY §5.7)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.ops import attention_core as ac
+from bigdl_tpu.ops.flash_attention import flash_attention
+
+RTOL, ATOL = 2e-4, 2e-4
+
+
+def _rand(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+class TestLayerNorm:
+    def test_forward_vs_torch(self):
+        m = nn.LayerNorm(16)
+        m.weight = jnp.asarray(_rand(16))
+        m.bias = jnp.asarray(_rand(16))
+        x = _rand(4, 7, 16)
+        t = torch.nn.LayerNorm(16)
+        with torch.no_grad():
+            t.weight.copy_(torch.from_numpy(np.asarray(m.weight)))
+            t.bias.copy_(torch.from_numpy(np.asarray(m.bias)))
+        np.testing.assert_allclose(
+            np.asarray(m.forward(jnp.asarray(x))),
+            t(torch.from_numpy(x)).detach().numpy(), rtol=RTOL, atol=ATOL)
+
+
+class TestDotProductAttention:
+    def test_vs_torch_sdpa(self):
+        b, s, n, d = 2, 9, 3, 8
+        q, k, v = _rand(b, s, n, d), _rand(b, s, n, d), _rand(b, s, n, d)
+        out = ac.dot_product_attention(*map(jnp.asarray, (q, k, v)))
+        ref = torch.nn.functional.scaled_dot_product_attention(
+            *(torch.from_numpy(x).permute(0, 2, 1, 3) for x in (q, k, v)))
+        np.testing.assert_allclose(np.asarray(out),
+                                   ref.permute(0, 2, 1, 3).numpy(),
+                                   rtol=RTOL, atol=ATOL)
+
+    def test_causal_vs_torch(self):
+        b, s, n, d = 2, 11, 2, 8
+        q, k, v = _rand(b, s, n, d), _rand(b, s, n, d), _rand(b, s, n, d)
+        out = ac.dot_product_attention(*map(jnp.asarray, (q, k, v)),
+                                       causal=True)
+        ref = torch.nn.functional.scaled_dot_product_attention(
+            *(torch.from_numpy(x).permute(0, 2, 1, 3) for x in (q, k, v)),
+            is_causal=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   ref.permute(0, 2, 1, 3).numpy(),
+                                   rtol=RTOL, atol=ATOL)
+
+    def test_mask(self):
+        b, s, n, d = 1, 6, 2, 4
+        q, k, v = _rand(b, s, n, d), _rand(b, s, n, d), _rand(b, s, n, d)
+        mask = np.tril(np.ones((s, s), bool))[None, None]
+        masked = ac.dot_product_attention(*map(jnp.asarray, (q, k, v)),
+                                          mask=jnp.asarray(mask))
+        causal = ac.dot_product_attention(*map(jnp.asarray, (q, k, v)),
+                                          causal=True)
+        np.testing.assert_allclose(np.asarray(masked), np.asarray(causal),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_fully_masked_row_is_zero(self):
+        b, s, n, d = 1, 5, 2, 4
+        q, k, v = (jnp.asarray(_rand(b, s, n, d)) for _ in range(3))
+        mask = np.ones((1, 1, s, s), bool)
+        mask[..., 2, :] = False  # query row 2 attends nothing
+        for fn in (lambda: ac.dot_product_attention(q, k, v,
+                                                    mask=jnp.asarray(mask)),
+                   lambda: ac.blockwise_attention(q, k, v,
+                                                  mask=jnp.asarray(mask),
+                                                  block_size=2)):
+            out = np.asarray(fn())
+            np.testing.assert_allclose(out[:, 2], 0.0, atol=1e-6)
+            assert np.abs(out[:, 1]).max() > 0
+
+    def test_causal_alignment_consistent_sq_ne_sk(self):
+        # All three cores must agree on top-left causal alignment.
+        b, sq, sk, n, d = 1, 3, 6, 2, 4
+        q = jnp.asarray(_rand(b, sq, n, d))
+        k, v = (jnp.asarray(_rand(b, sk, n, d)) for _ in range(2))
+        plain = ac.dot_product_attention(q, k, v, causal=True)
+        blk = ac.blockwise_attention(q, k, v, causal=True, block_size=2)
+        fl = flash_attention(q, k, v, causal=True, block_q=8, block_k=8,
+                             interpret=True)
+        np.testing.assert_allclose(np.asarray(blk), np.asarray(plain),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(fl), np.asarray(plain),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestBlockwiseAttention:
+    @pytest.mark.parametrize("s,block,causal", [
+        (16, 4, False), (17, 4, False), (16, 4, True), (23, 8, True),
+        (8, 16, False),  # block > seq
+    ])
+    def test_matches_plain(self, s, block, causal):
+        b, n, d = 2, 2, 8
+        q, k, v = (jnp.asarray(_rand(b, s, n, d)) for _ in range(3))
+        plain = ac.dot_product_attention(q, k, v, causal=causal)
+        blk = ac.blockwise_attention(q, k, v, causal=causal, block_size=block)
+        np.testing.assert_allclose(np.asarray(blk), np.asarray(plain),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_matches(self):
+        b, s, n, d = 1, 12, 2, 4
+        q, k, v = (jnp.asarray(_rand(b, s, n, d)) for _ in range(3))
+
+        def loss_plain(q):
+            return jnp.sum(ac.dot_product_attention(q, k, v, causal=True) ** 2)
+
+        def loss_blk(q):
+            return jnp.sum(ac.blockwise_attention(
+                q, k, v, causal=True, block_size=4) ** 2)
+
+        np.testing.assert_allclose(np.asarray(jax.grad(loss_blk)(q)),
+                                   np.asarray(jax.grad(loss_plain)(q)),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestFlashKernel:
+    @pytest.mark.parametrize("s,causal", [(32, False), (32, True), (40, True)])
+    def test_interpret_matches_plain(self, s, causal):
+        b, n, d = 2, 2, 8
+        q, k, v = (jnp.asarray(_rand(b, s, n, d)) for _ in range(3))
+        plain = ac.dot_product_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(plain),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad(self):
+        b, s, n, d = 1, 16, 1, 8
+        q, k, v = (jnp.asarray(_rand(b, s, n, d)) for _ in range(3))
+
+        def loss_flash(q):
+            return jnp.sum(flash_attention(q, k, v, causal=True, block_q=8,
+                                           block_k=8, interpret=True) ** 2)
+
+        def loss_plain(q):
+            return jnp.sum(ac.dot_product_attention(q, k, v, causal=True) ** 2)
+
+        np.testing.assert_allclose(np.asarray(jax.grad(loss_flash)(q)),
+                                   np.asarray(jax.grad(loss_plain)(q)),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestMultiHeadAttention:
+    def test_self_attention_vs_torch(self):
+        e, n, b, s = 16, 4, 2, 7
+        m = nn.MultiHeadAttention(e, n)
+        t = torch.nn.MultiheadAttention(e, n, batch_first=True)
+        with torch.no_grad():
+            t.in_proj_weight.copy_(
+                torch.from_numpy(np.asarray(m.in_proj_weight)))
+            t.in_proj_bias.copy_(torch.from_numpy(np.asarray(m.in_proj_bias)))
+            t.out_proj.weight.copy_(
+                torch.from_numpy(np.asarray(m.out_proj_weight)))
+            t.out_proj.bias.copy_(
+                torch.from_numpy(np.asarray(m.out_proj_bias)))
+        x = _rand(b, s, e)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        ref, _ = t(*(torch.from_numpy(x),) * 3, need_weights=False)
+        np.testing.assert_allclose(out, ref.detach().numpy(),
+                                   rtol=RTOL, atol=ATOL)
+
+    def test_causal_matches_torch_mask(self):
+        e, n, b, s = 8, 2, 1, 5
+        m = nn.MultiHeadAttention(e, n, causal=True)
+        t = torch.nn.MultiheadAttention(e, n, batch_first=True)
+        with torch.no_grad():
+            t.in_proj_weight.copy_(
+                torch.from_numpy(np.asarray(m.in_proj_weight)))
+            t.in_proj_bias.copy_(torch.from_numpy(np.asarray(m.in_proj_bias)))
+            t.out_proj.weight.copy_(
+                torch.from_numpy(np.asarray(m.out_proj_weight)))
+            t.out_proj.bias.copy_(
+                torch.from_numpy(np.asarray(m.out_proj_bias)))
+        x = _rand(b, s, e)
+        am = torch.triu(torch.full((s, s), float("-inf")), diagonal=1)
+        ref, _ = t(*(torch.from_numpy(x),) * 3, attn_mask=am,
+                   need_weights=False)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, ref.detach().numpy(),
+                                   rtol=RTOL, atol=ATOL)
+
+    def test_cross_attention_table(self):
+        from bigdl_tpu.utils.table import T
+        e, n = 8, 2
+        m = nn.MultiHeadAttention(e, n)
+        q, kv = _rand(2, 3, e), _rand(2, 6, e)
+        out = m.forward(T(jnp.asarray(q), jnp.asarray(kv), jnp.asarray(kv)))
+        assert out.shape == (2, 3, e)
+
+    def test_per_batch_mask_flows_through_input(self):
+        # A mask passed in the input Table must vary across jitted calls
+        # (set_mask state would be baked in as a trace constant).
+        from bigdl_tpu.nn.module import functional_apply
+        e, n, b, s = 8, 2, 1, 4
+        m = nn.MultiHeadAttention(e, n)
+        params, buffers = m.parameter_tree(), m.buffer_tree()
+        x = jnp.asarray(_rand(b, s, e))
+
+        @jax.jit
+        def f(p, bufs, x, mask):
+            y, _ = functional_apply(m, p, bufs, (x, x, x, mask),
+                                    training=False)
+            return y
+
+        full = np.ones((1, 1, s, s), bool)
+        causal = np.tril(full)
+        out_full = f(params, buffers, x, jnp.asarray(full))
+        out_causal = f(params, buffers, x, jnp.asarray(causal))
+        assert np.abs(np.asarray(out_full) - np.asarray(out_causal)).max() > 1e-5
+        ref = nn.MultiHeadAttention(e, n, causal=True)
+        ref.load_parameter_tree(params)
+        np.testing.assert_allclose(np.asarray(out_causal),
+                                   np.asarray(ref.forward(x)),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestTransformerEncoder:
+    def test_shapes_and_jit(self):
+        from bigdl_tpu.nn.module import functional_apply
+        enc = nn.TransformerEncoder(2, 16, 4, 32, causal=True)
+        x = jnp.asarray(_rand(2, 10, 16))
+        out = enc.forward(x)
+        assert out.shape == (2, 10, 16)
+        params, buffers = enc.parameter_tree(), enc.buffer_tree()
+
+        @jax.jit
+        def f(p, b, x):
+            y, _ = functional_apply(enc, p, b, x, training=False)
+            return y
+
+        np.testing.assert_allclose(np.asarray(f(params, buffers, x)),
+                                   np.asarray(out), rtol=1e-5, atol=1e-5)
+
+    def test_grad_flows(self):
+        from bigdl_tpu.nn.module import functional_apply
+        enc = nn.TransformerEncoderLayer(8, 2, 16)
+        x = jnp.asarray(_rand(1, 4, 8))
+        params, buffers = enc.parameter_tree(), enc.buffer_tree()
+
+        def loss(p):
+            y, _ = functional_apply(enc, p, buffers, x, training=False)
+            return jnp.sum(y ** 2)
+
+        g = jax.grad(loss)(params)
+        leaves = jax.tree_util.tree_leaves(g)
+        assert all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
+        assert any(float(jnp.abs(l).max()) > 0 for l in leaves)
+
+    def test_positional_encoding(self):
+        pe = nn.PositionalEncoding(16, max_len=32)
+        x = jnp.zeros((1, 10, 16))
+        out = np.asarray(pe.forward(x))
+        # position 0: sin(0)=0, cos(0)=1 alternating
+        np.testing.assert_allclose(out[0, 0, 0::2], 0.0, atol=1e-6)
+        np.testing.assert_allclose(out[0, 0, 1::2], 1.0, atol=1e-6)
+
+    def test_positional_encoding_odd_dim(self):
+        pe = nn.PositionalEncoding(15, max_len=8)
+        assert pe.forward(jnp.zeros((1, 4, 15))).shape == (1, 4, 15)
