@@ -132,9 +132,13 @@ def _block_scan(q, k, v, mask_bias, causal, scale, q_offset, block_size):
     if pad and mask_bias is not None:
         mask_bias = jnp.pad(mask_bias, ((0, 0),) * 3 + ((0, pad),),
                             constant_values=neg)
-    acc0 = jnp.zeros((b, sq, n, d), jnp.float32)
-    sum0 = jnp.zeros((b, n, sq), jnp.float32)
-    max0 = jnp.full((b, n, sq), neg, jnp.float32)
+    # Derive the zero carries from q so they carry q's device-varying type
+    # when traced inside shard_map (vma typing rejects unvarying inits whose
+    # loop outputs vary over a mesh axis).
+    acc0 = jnp.zeros_like(q, dtype=jnp.float32)
+    zero_bnq = jnp.sum(q * 0.0, axis=-1, dtype=jnp.float32).transpose(0, 2, 1)
+    sum0 = zero_bnq
+    max0 = zero_bnq + neg
     (acc, rsum, rmax), _ = lax.scan(
         step, (acc0, sum0, max0),
         (kb, vb, jnp.arange(nblocks)))

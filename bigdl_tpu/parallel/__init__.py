@@ -10,3 +10,5 @@ lacks: tensor/pipeline/sequence(ring-attention)/expert parallelism.
 """
 
 from bigdl_tpu.parallel.mesh import MeshTopology
+from bigdl_tpu.parallel.context import (
+    ring_attention, ulysses_attention, ring_self_attention)

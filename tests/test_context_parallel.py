@@ -1,0 +1,112 @@
+"""Context-parallel attention tests on the 8-device virtual CPU mesh.
+
+Mirrors the reference's cluster-in-one-JVM strategy
+(``DistriOptimizerSpec.scala:40-42``): sharding runs for real over 8 XLA
+host devices; correctness oracle is single-device attention.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops import attention_core as ac
+from bigdl_tpu.parallel.context import ring_self_attention
+from bigdl_tpu.parallel.mesh import MeshTopology
+
+
+def _mesh(n=8):
+    return MeshTopology(sequence=n).build()
+
+
+def _rand(*shape):
+    return jnp.asarray(np.random.randn(*shape).astype(np.float32))
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_single_device(mode, causal):
+    b, s, n, d = 2, 32, 8, 8   # 8 heads so ulysses divides over 8 devices
+    q, k, v = _rand(b, s, n, d), _rand(b, s, n, d), _rand(b, s, n, d)
+    mesh = _mesh()
+    out = ring_self_attention(q, k, v, mesh, causal=causal, mode=mode)
+    ref = ac.dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_grad_matches(tolerance=1e-4):
+    b, s, n, d = 1, 16, 2, 4
+    q, k, v = _rand(b, s, n, d), _rand(b, s, n, d), _rand(b, s, n, d)
+    mesh = _mesh()
+
+    def loss_ring(q):
+        return jnp.sum(ring_self_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_ref(q):
+        return jnp.sum(ac.dot_product_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring)(q)
+    g_ref = jax.grad(loss_ref)(q)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
+                               rtol=tolerance, atol=tolerance)
+
+
+def test_ring_jits_and_shards():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    b, s, n, d = 1, 64, 2, 8
+    mesh = _mesh()
+    q, k, v = _rand(b, s, n, d), _rand(b, s, n, d), _rand(b, s, n, d)
+    sh = NamedSharding(mesh, P(None, "seq", None, None))
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+
+    f = jax.jit(lambda q, k, v: ring_self_attention(q, k, v, mesh,
+                                                    causal=True))
+    out = f(q, k, v)
+    assert out.sharding.spec == P(None, "seq", None, None)
+    ref = ac.dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_transformer_encoder_context_parallel():
+    # Full transformer stack sharded over the seq axis inside shard_map
+    # matches the single-device stack with identical weights.
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.module import functional_apply
+
+    e, heads, s, b = 16, 8, 32, 2
+    enc_sp = nn.TransformerEncoder(2, e, heads, 32, causal=True,
+                                   seq_axis="seq")
+    enc_ref = nn.TransformerEncoder(2, e, heads, 32, causal=True)
+    enc_ref.load_parameter_tree(enc_sp.parameter_tree())
+    params, buffers = enc_sp.parameter_tree(), enc_sp.buffer_tree()
+    x = _rand(b, s, e)
+    mesh = _mesh()
+
+    def local_fn(p, bufs, x):
+        y, _ = functional_apply(enc_sp, p, bufs, x, training=False)
+        return y
+
+    f = shard_map(local_fn, mesh=mesh,
+                  in_specs=(P(), P(), P(None, "seq", None)),
+                  out_specs=P(None, "seq", None))
+    out = f(params, buffers, x)
+    ref = enc_ref.forward(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-5, atol=5e-5)
+
+
+def test_ring_long_sequence_blocks():
+    # Sequence not divisible concerns: S must divide by axis size (the
+    # DataSet batching pads to multiples); verify a bigger S works.
+    b, s, n, d = 1, 128, 4, 8
+    mesh = _mesh()
+    q, k, v = _rand(b, s, n, d), _rand(b, s, n, d), _rand(b, s, n, d)
+    out = ring_self_attention(q, k, v, mesh, causal=True)
+    ref = ac.blockwise_attention(q, k, v, causal=True, block_size=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
