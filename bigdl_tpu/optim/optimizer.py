@@ -219,8 +219,11 @@ class LocalOptimizer(Optimizer):
 
     def optimize(self) -> Module:
         model = self.model
-        params = model.parameter_tree()
-        buffers = model.buffer_tree()
+        # Private copies: the jitted step donates its param/buffer inputs, and
+        # donating the model's own arrays would delete buffers any other
+        # reference (a cloned model, user code) still points at.
+        params = jax.tree_util.tree_map(jnp.array, model.parameter_tree())
+        buffers = jax.tree_util.tree_map(jnp.array, model.buffer_tree())
         opt_state = self._init_opt_state(params)
         driver_state = T(epoch=1, neval=1)
         driver_state.update(self.state)

@@ -43,7 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.nn.module import functional_apply
 from bigdl_tpu.optim.optimizer import LocalOptimizer, Optimizer, _regularizer_pairs, _reg_loss
-from bigdl_tpu.parallel.mesh import DATA_AXIS, MeshTopology
+from bigdl_tpu.parallel.mesh import DATA_AXIS, TENSOR_AXIS, MeshTopology
 
 logger = logging.getLogger("bigdl_tpu.optim")
 
@@ -60,9 +60,15 @@ class DistriOptimizer(LocalOptimizer):
         self.topology = topology or MeshTopology.data_parallel()
         self.sync_mode = sync_mode
         self.compress_gradients = compress_gradients
+        if sync_mode == "sharded" and (topology and topology.sizes.get("tensor", 1) > 1):
+            raise ValueError("sync_mode='sharded' (ZeRO-1 flat slices) is a "
+                             "data-axis layout; combine tensor parallelism "
+                             "with sync_mode='allreduce'")
         self.mesh: Mesh = self.topology.build()
         self._n_data = self.mesh.shape.get(DATA_AXIS, 1)
-        self._batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self._n_tensor = self.mesh.shape.get(TENSOR_AXIS, 1)
+        batch_spec = P(DATA_AXIS) if DATA_AXIS in self.mesh.shape else P()
+        self._batch_sharding = NamedSharding(self.mesh, batch_spec)
         self._replicated = NamedSharding(self.mesh, P())
 
     # ------------------------------------------------------------- placement
@@ -103,6 +109,26 @@ class DistriOptimizer(LocalOptimizer):
             return new_params, new_buf, new_opt_state, loss
 
         rep, bat = self._replicated, self._batch_sharding
+        if self._n_tensor > 1:
+            # Tensor parallelism: per-leaf parameter shardings over the
+            # tensor axis (Megatron column/row rules); GSPMD inserts the
+            # activation collectives. Optimizer state mirrors param specs.
+            from bigdl_tpu.parallel.tensor_parallel import (
+                infer_param_specs, opt_state_specs)
+            params0 = self.model.parameter_tree()
+            p_specs = infer_param_specs(self.model,
+                                        axis_size=self._n_tensor)
+            state_tpl = jax.eval_shape(optim.init_state, params0)
+            s_specs = opt_state_specs(state_tpl, params0, p_specs)
+            named = lambda tree: jax.tree_util.tree_map(
+                lambda sp: NamedSharding(self.mesh, sp), tree,
+                is_leaf=lambda x: isinstance(x, P))
+            p_sh, s_sh = named(p_specs), named(s_specs)
+            return jax.jit(
+                step,
+                in_shardings=(p_sh, rep, s_sh, rep, bat, bat),
+                out_shardings=(p_sh, rep, s_sh, rep),
+                donate_argnums=(0, 1, 2))
         return jax.jit(
             step,
             in_shardings=(rep, rep, rep, rep, bat, bat),
