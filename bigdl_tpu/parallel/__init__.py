@@ -12,3 +12,8 @@ lacks: tensor/pipeline/sequence(ring-attention)/expert parallelism.
 from bigdl_tpu.parallel.mesh import MeshTopology
 from bigdl_tpu.parallel.context import (
     ring_attention, ulysses_attention, ring_self_attention)
+from bigdl_tpu.parallel.tensor_parallel import (
+    COLUMN, ROW, infer_param_specs)
+from bigdl_tpu.parallel.pipeline import (
+    PipelineStack, gpipe_loss_fn, pipeline_spec_tree)
+from bigdl_tpu.parallel.expert import MoE, expert_param_specs, inject_loss

@@ -109,15 +109,16 @@ class DistriOptimizer(LocalOptimizer):
             return new_params, new_buf, new_opt_state, loss
 
         rep, bat = self._replicated, self._batch_sharding
-        if self._n_tensor > 1:
-            # Tensor parallelism: per-leaf parameter shardings over the
-            # tensor axis (Megatron column/row rules); GSPMD inserts the
-            # activation collectives. Optimizer state mirrors param specs.
+        if self._n_tensor > 1 or self.mesh.shape.get("expert", 1) > 1:
+            # Tensor/expert parallelism: per-leaf parameter shardings
+            # (Megatron column/row rules, MoE expert stacking); GSPMD
+            # inserts the activation collectives/all_to_alls. Optimizer
+            # state mirrors the param specs.
             from bigdl_tpu.parallel.tensor_parallel import (
                 infer_param_specs, opt_state_specs)
             params0 = self.model.parameter_tree()
             p_specs = infer_param_specs(self.model,
-                                        axis_size=self._n_tensor)
+                                        axis_size=dict(self.mesh.shape))
             state_tpl = jax.eval_shape(optim.init_state, params0)
             s_specs = opt_state_specs(state_tpl, params0, p_specs)
             named = lambda tree: jax.tree_util.tree_map(

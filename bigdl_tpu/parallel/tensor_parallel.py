@@ -47,10 +47,13 @@ def _linear_specs(mode: Optional[str], axis: str) -> Dict[str, P]:
 def _module_specs(module, axis: str) -> Dict[str, P]:
     """Specs for the module's OWN parameters (not children)."""
     from bigdl_tpu import nn
+    from bigdl_tpu.parallel.expert import MoE, expert_param_specs
 
     mode = getattr(module, "tp_mode", None)
     if mode == REPLICATE:
         return {}
+    if isinstance(module, MoE):
+        return expert_param_specs(module)
     if isinstance(module, nn.Linear):
         return _linear_specs(mode, axis)
     if isinstance(module, nn.MultiHeadAttention):
@@ -75,12 +78,14 @@ def _tag_children(module) -> None:
 
 
 def infer_param_specs(model, axis: str = TENSOR_AXIS,
-                      axis_size: Optional[int] = None) -> Any:
+                      axis_size=None) -> Any:
     """Pytree of PartitionSpec matching ``model.parameter_tree()``.
 
     ``axis_size``: when given, a would-be sharded dimension not divisible by
     it falls back to replicated (GSPMD would otherwise pad-and-mask with
-    uneven shards; explicit replication is cheaper and predictable).
+    uneven shards; explicit replication is cheaper and predictable). Either
+    an int (applies to every named axis) or a dict {axis_name: size} — pass
+    ``dict(mesh.shape)`` to validate mixed tensor/expert specs.
     """
     _tag_children(model)
 
@@ -88,7 +93,11 @@ def infer_param_specs(model, axis: str = TENSOR_AXIS,
         if axis_size is None:
             return True
         for dim, name in enumerate(spec):
-            if name is not None and shape[dim] % axis_size != 0:
+            if name is None:
+                continue
+            size = (axis_size.get(name) if isinstance(axis_size, dict)
+                    else axis_size)
+            if size and shape[dim] % size != 0:
                 return False
         return True
 
