@@ -29,11 +29,12 @@ from bigdl_tpu import optim
 from bigdl_tpu import dataset
 from bigdl_tpu import parallel
 from bigdl_tpu import utils
+from bigdl_tpu import visualization
 
 __version__ = "0.1.0"
 
 __all__ = [
     "Engine", "Table", "T",
-    "nn", "optim", "dataset", "parallel", "utils",
+    "nn", "optim", "dataset", "parallel", "utils", "visualization",
     "__version__",
 ]

@@ -276,6 +276,11 @@ class LocalOptimizer(Optimizer):
                     if hasattr(self.optim_method, "current_rate"):
                         lr = float(self.optim_method.current_rate(opt_state))
                         self.train_summary.add_scalar("LearningRate", lr, neval)
+                    ptrig = (self.train_summary.get_summary_trigger("Parameters")
+                             if hasattr(self.train_summary, "get_summary_trigger")
+                             else None)
+                    if ptrig is not None and ptrig(driver_state):
+                        self._summarize_parameters(params, neval)
                 epoch_records += n_records
                 driver_state["neval"] = neval + 1
                 self._hooks(params, buffers, opt_state, driver_state, fwd,
@@ -294,6 +299,18 @@ class LocalOptimizer(Optimizer):
         model.load_parameter_tree(self._finalize_params(params))
         model.load_buffer_tree(buffers)
         return model
+
+    def _summarize_parameters(self, params, neval: int) -> None:
+        """Per-parameter histograms (reference ``TrainSummary`` "Parameters"
+        trigger, ``DistriOptimizer.scala:410-440``)."""
+        import jax.tree_util as jtu
+        # sharded DistriOptimizer carries a flat padded vector; unravel it
+        # back to the named pytree before logging per-parameter histograms
+        flat = jtu.tree_flatten_with_path(self._finalize_params(params))[0]
+        for path, leaf in flat:
+            tag = "Parameters/" + "/".join(
+                str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            self.train_summary.add_histogram(tag, np.asarray(leaf), neval)
 
     # ------------------------------------------------------------------ hooks
     def _hooks(self, params, buffers, opt_state, driver_state, fwd,

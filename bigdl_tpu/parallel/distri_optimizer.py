@@ -60,10 +60,11 @@ class DistriOptimizer(LocalOptimizer):
         self.topology = topology or MeshTopology.data_parallel()
         self.sync_mode = sync_mode
         self.compress_gradients = compress_gradients
-        if sync_mode == "sharded" and (topology and topology.sizes.get("tensor", 1) > 1):
+        if sync_mode == "sharded" and topology and any(
+                topology.sizes.get(ax, 1) > 1 for ax in ("tensor", "expert")):
             raise ValueError("sync_mode='sharded' (ZeRO-1 flat slices) is a "
-                             "data-axis layout; combine tensor parallelism "
-                             "with sync_mode='allreduce'")
+                             "data-axis layout; combine tensor/expert "
+                             "parallelism with sync_mode='allreduce'")
         self.mesh: Mesh = self.topology.build()
         self._n_data = self.mesh.shape.get(DATA_AXIS, 1)
         self._n_tensor = self.mesh.shape.get(TENSOR_AXIS, 1)

@@ -97,6 +97,8 @@ def infer_param_specs(model, axis: str = TENSOR_AXIS,
                 continue
             size = (axis_size.get(name) if isinstance(axis_size, dict)
                     else axis_size)
+            if size is None:
+                return False  # axis absent from the mesh → replicate
             if size and shape[dim] % size != 0:
                 return False
         return True

@@ -1,0 +1,174 @@
+"""Observability tests (reference strategy: tensorboard readback is exercised
+from the Python API, ``pyspark`` tests + ``$T`` visualization specs)."""
+
+import glob
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.visualization import (FileReader, FileWriter, RecordWriter,
+                                     TrainSummary, ValidationSummary)
+from bigdl_tpu.visualization import proto
+from bigdl_tpu.visualization.tensorboard import crc32c, masked_crc32c
+
+
+class TestCrc32c:
+    def test_known_vectors(self):
+        # RFC 3720 / kernel test vectors for CRC32C (Castagnoli)
+        assert crc32c(b"") == 0x00000000
+        assert crc32c(b"123456789") == 0xE3069283
+        assert crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert crc32c(b"\xff" * 32) == 0x62A8AB43
+
+    def test_masked(self):
+        # masking formula: rotr15(crc) + 0xa282ead8
+        crc = crc32c(b"123456789")
+        expect = (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+        assert masked_crc32c(b"123456789") == expect
+
+
+class TestProto:
+    def test_event_roundtrip(self):
+        ev = proto.encode_event(wall_time=123.5, step=7,
+                                summary_values=[proto.encode_scalar_value("Loss", 0.25)])
+        dec = proto.decode_event(ev)
+        assert dec["wall_time"] == 123.5
+        assert dec["step"] == 7
+        assert dec["scalars"] == [("Loss", 0.25)]
+
+    def test_file_version_event(self):
+        dec = proto.decode_event(proto.encode_event(1.0, file_version="brain.Event:2"))
+        assert dec["file_version"] == "brain.Event:2"
+
+    def test_histogram_stats(self):
+        v = np.array([1.0, 2.0, 3.0])
+        msg = proto.encode_histogram(v)
+        # decode doubles for fields 1..5
+        fields = {}
+        pos = 0
+        while pos < len(msg):
+            key = msg[pos]
+            field, wt = key >> 3, key & 7
+            pos += 1
+            if wt == 1:
+                fields[field] = struct.unpack("<d", msg[pos:pos + 8])[0]
+                pos += 8
+            elif wt == 2:
+                n = msg[pos]
+                pos += 1 + n
+        assert fields[1] == 1.0 and fields[2] == 3.0
+        assert fields[3] == 3.0 and fields[4] == 6.0 and fields[5] == 14.0
+
+
+class TestRecordFraming:
+    def test_roundtrip_and_crc(self, tmp_path):
+        p = tmp_path / "rec.bin"
+        with open(p, "wb") as f:
+            w = RecordWriter(f)
+            w.write(b"hello")
+            w.write(b"world" * 100)
+        recs = list(FileReader.read_records(str(p)))
+        assert recs == [b"hello", b"world" * 100]
+
+    def test_corruption_detected(self, tmp_path):
+        p = tmp_path / "rec.bin"
+        with open(p, "wb") as f:
+            RecordWriter(f).write(b"payload")
+        data = bytearray(open(p, "rb").read())
+        data[-6] ^= 0xFF  # flip a payload byte
+        open(p, "wb").write(bytes(data))
+        with pytest.raises(IOError):
+            list(FileReader.read_records(str(p)))
+
+
+class TestFileWriter:
+    def test_scalar_readback(self, tmp_path):
+        d = str(tmp_path / "logs")
+        w = FileWriter(d)
+        for i in range(5):
+            w.add_scalar("Loss", 1.0 / (i + 1), i)
+        w.add_scalar("Other", 42.0, 0)
+        w.close()
+        got = FileReader.read_scalar(d, "Loss")
+        assert [s for s, _, _ in got] == [0, 1, 2, 3, 4]
+        assert got[0][1] == pytest.approx(1.0)
+        assert got[4][1] == pytest.approx(0.2)
+
+    def test_first_record_is_file_version(self, tmp_path):
+        d = str(tmp_path / "logs")
+        FileWriter(d).close()
+        f = FileReader.list_event_files(d)[0]
+        first = next(FileReader.read_records(f))
+        assert proto.decode_event(first)["file_version"] == "brain.Event:2"
+
+    def test_histogram_record_written(self, tmp_path):
+        d = str(tmp_path / "logs")
+        w = FileWriter(d)
+        w.add_histogram("Parameters/w", np.random.randn(100), 3)
+        w.close()
+        f = FileReader.list_event_files(d)[0]
+        recs = list(FileReader.read_records(f))
+        assert len(recs) == 2  # version + histogram (CRC-validated)
+
+
+class TestSummaries:
+    def test_train_summary(self, tmp_path):
+        s = TrainSummary(str(tmp_path), "app")
+        s.add_scalar("Loss", 0.5, 1).add_scalar("Loss", 0.4, 2)
+        got = s.read_scalar("Loss")
+        assert [(st, v) for st, v, _ in got] == [(1, pytest.approx(0.5)),
+                                                 (2, pytest.approx(0.4))]
+        assert "train" in os.path.relpath(
+            FileReader.list_event_files(s.folder)[0], str(tmp_path))
+
+    def test_validation_summary_separate_dir(self, tmp_path):
+        t = TrainSummary(str(tmp_path), "app")
+        v = ValidationSummary(str(tmp_path), "app")
+        assert t.folder != v.folder
+        t.close(); v.close()
+
+    def test_summary_trigger_validation(self):
+        from bigdl_tpu.optim.triggers import Trigger
+        s = TrainSummary("/tmp/unused-xyz", "app")
+        s.set_summary_trigger("Parameters", Trigger.several_iteration(10))
+        assert s.get_summary_trigger("Parameters") is not None
+        with pytest.raises(ValueError):
+            s.set_summary_trigger("Bogus", Trigger.every_epoch())
+
+
+class TestOptimizerIntegration:
+    def test_training_writes_summaries(self, tmp_path):
+        import bigdl_tpu as bt
+        from bigdl_tpu.dataset.base import DataSet, Sample
+        from bigdl_tpu.optim.triggers import Trigger
+
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randn(4).astype(np.float32),
+                          np.int32(rng.randint(0, 2)) + 1) for _ in range(32)]
+        ds = DataSet.array(samples).transform(
+            bt.dataset.SampleToBatch(batch_size=16))
+        model = bt.nn.Sequential().add(bt.nn.Linear(4, 2)).add(bt.nn.LogSoftMax())
+        ts = TrainSummary(str(tmp_path), "job")
+        ts.set_summary_trigger("Parameters", Trigger.several_iteration(1))
+        vs = ValidationSummary(str(tmp_path), "job")
+        opt = bt.optim.Optimizer(model, ds, bt.nn.ClassNLLCriterion())
+        opt.set_end_when(Trigger.max_epoch(2))
+        opt.set_train_summary(ts).set_validation_summary(vs)
+        opt.set_validation(Trigger.every_epoch(), ds,
+                           [bt.optim.Top1Accuracy()])
+        opt.optimize()
+        loss = ts.read_scalar("Loss")
+        thr = ts.read_scalar("Throughput")
+        assert len(loss) == 4 and len(thr) == 4  # 2 epochs x 2 iterations
+        acc = vs.read_scalar("Top1Accuracy")
+        assert len(acc) == 2
+        # Parameters histograms present as records
+        files = FileReader.list_event_files(ts.folder)
+        n_hist = 0
+        for f in files:
+            for rec in FileReader.read_records(f):
+                ev = proto.decode_event(rec)
+                n_hist += 0 if ev["scalars"] else 1
+        assert n_hist > 2  # file-version + >=1 histogram event
