@@ -17,3 +17,5 @@ from bigdl_tpu.parallel.tensor_parallel import (
 from bigdl_tpu.parallel.pipeline import (
     PipelineStack, gpipe_loss_fn, pipeline_spec_tree)
 from bigdl_tpu.parallel.expert import MoE, expert_param_specs, inject_loss
+from bigdl_tpu.parallel.compression import (
+    CompressedTensor, SerializerInstance, fp32_to_bf16, bf16_to_fp32)

@@ -60,6 +60,13 @@ class Engine:
                                   else max(1, len(_state._devices) // max(1, _state.node_number)))
             _state._mesh = None  # rebuilt lazily against the new device set
             _state.initialized = True
+        # pin the native runtime's host threads to the declared core budget
+        # (reference ThreadPool.setMKLThread / MKL.setNumThreads)
+        try:
+            from bigdl_tpu import native
+            native.set_num_threads(_state.core_number)
+        except Exception:  # pragma: no cover - native layer is optional
+            pass
 
     @staticmethod
     def is_initialized() -> bool:

@@ -75,11 +75,14 @@ def encode_scalar_value(tag: str, value: float) -> bytes:
 def encode_histogram(values: np.ndarray) -> bytes:
     """Encode a HistogramProto from raw values, TF-style exponential buckets."""
     v = np.asarray(values, dtype=np.float64).ravel()
+    # NaNs appear exactly when training diverges — the histogram must still
+    # encode (observability is most needed then), so bucket only finite values
+    v = v[np.isfinite(v)]
     if v.size == 0:
         v = np.zeros((1,), dtype=np.float64)
     limits = _bucket_limits()
     counts = np.zeros(len(limits), dtype=np.float64)
-    idx = np.searchsorted(limits, v, side="left")
+    idx = np.minimum(np.searchsorted(limits, v, side="left"), len(limits) - 1)
     np.add.at(counts, idx, 1.0)
     # trim empty tail/head buckets but keep one boundary bucket each side
     nz = np.nonzero(counts)[0]

@@ -45,20 +45,29 @@ def _crc_table() -> np.ndarray:
     return _CRC_TABLE
 
 
+_CRC_TABLE_LIST: Optional[list] = None
+
+
 def crc32c(data: bytes) -> int:
-    """CRC32C (Castagnoli), as the reference's ``netty/Crc32c.java``."""
+    """CRC32C (Castagnoli), as the reference's ``netty/Crc32c.java``.
+
+    Uses the native C++ slice-by-8 when available; the pure-Python fallback
+    is a byte-wise table loop (slow — the native path is the product path,
+    the fallback only keeps toolchain-less environments functional)."""
     try:
-        from bigdl_tpu.native import lib as _native
-        if _native is not None and hasattr(_native, "bt_crc32c"):
-            return _native.bt_crc32c(data, len(data)) & 0xFFFFFFFF
+        from bigdl_tpu import native
+        dll = native.load()
+        if dll is not None:
+            return dll.bt_crc32c(data, len(data)) & 0xFFFFFFFF
     except ImportError:
         pass
-    table = _crc_table()
+    global _CRC_TABLE_LIST
+    if _CRC_TABLE_LIST is None:
+        _CRC_TABLE_LIST = [int(x) for x in _crc_table()]
+    table = _CRC_TABLE_LIST
     crc = 0xFFFFFFFF
-    arr = np.frombuffer(data, dtype=np.uint8)
-    # table-driven, chunked through numpy to keep the Python loop short
-    for b in arr.tolist():
-        crc = (crc >> 8) ^ int(table[(crc ^ b) & 0xFF])
+    for b in data:
+        crc = (crc >> 8) ^ table[(crc ^ b) & 0xFF]
     return crc ^ 0xFFFFFFFF
 
 
@@ -88,17 +97,27 @@ class EventWriter:
     """Async event writer: queue + flush-interval thread
     (reference ``EventWriter.scala:31``)."""
 
+    _seq = 0
+    _seq_lock = threading.Lock()
+
     def __init__(self, log_dir: str, flush_secs: float = 2.0,
                  filename_suffix: str = ""):
         os.makedirs(log_dir, exist_ok=True)
+        # pid + per-process sequence number make the name unique even when
+        # several writers open within the same second (a second writer must
+        # never truncate an earlier writer's history)
+        with EventWriter._seq_lock:
+            EventWriter._seq += 1
+            seq = EventWriter._seq
         fname = (f"events.out.tfevents.{int(time.time())}"
-                 f".{os.uname().nodename}{filename_suffix}")
+                 f".{os.uname().nodename}.{os.getpid()}.{seq}{filename_suffix}")
         self.path = os.path.join(log_dir, fname)
         self._file = open(self.path, "wb")
         self._writer = RecordWriter(self._file)
         self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()
         self._flush_secs = flush_secs
         self._closed = False
+        self._dead = False  # set by the writer thread on unrecoverable IO error
         # first record is the file-version event, as TF writers emit
         self._writer.write(proto.encode_event(
             wall_time=time.time(), file_version="brain.Event:2"))
@@ -106,7 +125,7 @@ class EventWriter:
         self._thread.start()
 
     def add_event(self, event: bytes) -> None:
-        if not self._closed:
+        if not self._closed and not self._dead:
             self._queue.put(event)
 
     def _run(self) -> None:
@@ -119,12 +138,24 @@ class EventWriter:
                 item = ()
             if item is None:
                 break
-            if item:
-                self._writer.write(item)
-            if time.time() - last_flush >= self._flush_secs:
-                self._writer.flush()
-                last_flush = time.time()
-        self._writer.flush()
+            try:
+                if item:
+                    self._writer.write(item)
+                if time.time() - last_flush >= self._flush_secs:
+                    self._writer.flush()
+                    last_flush = time.time()
+            except OSError as e:
+                # disk full / closed file: mark dead so producers stop
+                # enqueueing, keep draining until close() — never die silently
+                if not self._dead:
+                    import logging
+                    logging.getLogger("bigdl_tpu.visualization").error(
+                        "event writer failed for %s: %s", self.path, e)
+                    self._dead = True
+        try:
+            self._writer.flush()
+        except OSError:
+            pass
 
     def close(self) -> None:
         if not self._closed:
@@ -172,11 +203,17 @@ class FileReader:
                 if len(header) < 8:
                     return
                 (length,) = struct.unpack("<Q", header)
-                (hcrc,) = struct.unpack("<I", f.read(4))
+                hcrc_bytes = f.read(4)
+                if len(hcrc_bytes) < 4:
+                    return  # truncated tail (crashed writer) — treat as EOF
+                (hcrc,) = struct.unpack("<I", hcrc_bytes)
                 if validate_crc and masked_crc32c(header) != hcrc:
                     raise IOError(f"corrupt record header in {path}")
                 data = f.read(length)
-                (dcrc,) = struct.unpack("<I", f.read(4))
+                dcrc_bytes = f.read(4)
+                if len(data) < length or len(dcrc_bytes) < 4:
+                    return  # truncated tail — drop the partial record
+                (dcrc,) = struct.unpack("<I", dcrc_bytes)
                 if validate_crc and masked_crc32c(data) != dcrc:
                     raise IOError(f"corrupt record payload in {path}")
                 yield data

@@ -1,0 +1,114 @@
+"""Native C++ runtime tests (reference §2.9 MKL JNI surface +
+``$T/parameters/FP16ParameterSpec.scala`` codec precision/concurrency specs)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import native
+from bigdl_tpu.parallel.compression import (CompressedTensor,
+                                            SerializerInstance,
+                                            bf16_to_fp32, fp32_to_bf16)
+
+
+def _numpy_truncate(x):
+    return (np.asarray(x, np.float32).view(np.uint32) >> 16).astype(np.uint16)
+
+
+class TestNativeBuild:
+    def test_builds_and_loads(self):
+        # the environment bakes g++, so the library must build here
+        assert native.is_loaded()
+
+    def test_crc32c_matches_python(self):
+        from bigdl_tpu.visualization.tensorboard import _crc_table
+        lib = native.load()
+        rng = np.random.RandomState(0)
+        for n in (0, 1, 7, 8, 9, 63, 1024, 4097):
+            data = rng.bytes(n)
+            # pure-python table impl
+            crc = 0xFFFFFFFF
+            table = _crc_table()
+            for b in data:
+                crc = (crc >> 8) ^ int(table[(crc ^ b) & 0xFF])
+            assert lib.bt_crc32c(data, n) == (crc ^ 0xFFFFFFFF)
+
+    def test_kth_largest(self):
+        import ctypes
+        lib = native.load()
+        vals = np.asarray([5.0, 1.0, 9.0, 3.0, 7.0], dtype=np.float64)
+        ptr = vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        assert lib.bt_kth_largest(ptr, 5, 1) == 9.0
+        assert lib.bt_kth_largest(ptr, 5, 3) == 5.0
+        assert lib.bt_kth_largest(ptr, 5, 5) == 1.0
+
+
+class TestBf16Codec:
+    def test_truncation_semantics(self):
+        # reference FP16CompressedTensor keeps fp32's top 16 bits exactly
+        x = np.random.RandomState(1).randn(10000).astype(np.float32)
+        assert np.array_equal(fp32_to_bf16(x), _numpy_truncate(x))
+
+    def test_roundtrip_precision(self):
+        # bf16 has 8 mantissa bits → relative error < 2^-8
+        x = np.random.RandomState(2).uniform(-10, 10, 5000).astype(np.float32)
+        y = bf16_to_fp32(fp32_to_bf16(x))
+        assert np.max(np.abs(y - x) / np.maximum(np.abs(x), 1e-6)) < 2 ** -7
+
+    def test_compress_decompress(self):
+        x = np.random.RandomState(3).randn(1000).astype(np.float32)
+        ct = CompressedTensor.from_array(x)
+        y = ct.decompress()
+        assert np.allclose(y, x, atol=0.1, rtol=2 ** -8)
+
+    def test_add_matches_reference_semantics(self):
+        # add = widen both, fp32 add, re-truncate (FP16CompressedTensor add)
+        rng = np.random.RandomState(4)
+        a, b = rng.randn(512).astype(np.float32), rng.randn(512).astype(np.float32)
+        ca, cb = CompressedTensor.from_array(a), CompressedTensor.from_array(b)
+        ca.add(cb)
+        wide = (bf16_to_fp32(_numpy_truncate(a))
+                + bf16_to_fp32(_numpy_truncate(b)))
+        assert np.array_equal(ca._data, _numpy_truncate(wide))
+
+    def test_accumulate_into(self):
+        rng = np.random.RandomState(5)
+        grad = rng.randn(256).astype(np.float32)
+        acc = np.ones(256, dtype=np.float32)
+        CompressedTensor.from_array(grad).accumulate_into(acc)
+        assert np.allclose(acc, 1.0 + bf16_to_fp32(_numpy_truncate(grad)))
+
+    def test_bytes_roundtrip(self):
+        x = np.random.RandomState(6).randn(128).astype(np.float32)
+        ct = CompressedTensor.from_array(x)
+        ct2 = CompressedTensor.from_bytes(ct.bytes())
+        assert np.array_equal(ct._data, ct2._data)
+        assert len(ct.bytes()) == 2 * x.size  # 2 bytes/element, as reference
+
+    def test_serializer_registry(self):
+        assert isinstance(SerializerInstance.create(8, "fp16"), CompressedTensor)
+        assert isinstance(SerializerInstance.create(8, "bf16"), CompressedTensor)
+        with pytest.raises(ValueError):
+            SerializerInstance.create(8, "int8")
+
+    def test_slice_compress_offset(self):
+        x = np.arange(16, dtype=np.float32)
+        ct = CompressedTensor(16)
+        ct.compress(x[:8], offset=0)
+        ct.compress(x[8:], offset=8)
+        assert np.allclose(ct.decompress(), x, rtol=2 ** -8, atol=1e-3)
+
+
+class TestFallbackParity:
+    def test_python_fallback_matches_native(self, monkeypatch):
+        x = np.random.RandomState(7).randn(333).astype(np.float32)
+        native_out = fp32_to_bf16(x)
+        monkeypatch.setattr(native, "load", lambda *a, **k: None)
+        assert np.array_equal(fp32_to_bf16(x), native_out)
+        assert np.array_equal(bf16_to_fp32(native_out),
+                              bf16_to_fp32(native_out))
+
+    def test_crc_python_fallback(self, monkeypatch):
+        from bigdl_tpu.visualization import tensorboard as tb
+        native_val = tb.crc32c(b"123456789")
+        monkeypatch.setattr(native, "load", lambda *a, **k: None)
+        assert tb.crc32c(b"123456789") == native_val == 0xE3069283

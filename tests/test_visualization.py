@@ -113,6 +113,38 @@ class TestFileWriter:
         assert len(recs) == 2  # version + histogram (CRC-validated)
 
 
+class TestRobustness:
+    def test_midtraining_readback_keeps_history(self, tmp_path):
+        # regression: a second EventWriter within the same second must not
+        # truncate the first one's file
+        s = TrainSummary(str(tmp_path), "app")
+        s.add_scalar("Loss", 1.0, 1)
+        assert [st for st, _, _ in s.read_scalar("Loss")] == [1]
+        s.add_scalar("Loss", 2.0, 2)  # new writer, same second
+        got = s.read_scalar("Loss")
+        assert [st for st, _, _ in got] == [1, 2]
+
+    def test_nan_histogram_encodes(self):
+        msg = proto.encode_histogram(np.array([1.0, np.nan, np.inf, 2.0]))
+        assert isinstance(msg, bytes) and len(msg) > 0
+
+    def test_all_nan_histogram_encodes(self):
+        assert proto.encode_histogram(np.array([np.nan, np.nan]))
+
+    def test_truncated_tail_is_eof(self, tmp_path):
+        p = tmp_path / "rec.bin"
+        with open(p, "wb") as f:
+            w = RecordWriter(f)
+            w.write(b"complete-record")
+            # simulate a crash mid-write: header + partial payload
+            header = struct.pack("<Q", 100)
+            f.write(header)
+            f.write(struct.pack("<I", masked_crc32c(header)))
+            f.write(b"partial")
+        recs = list(FileReader.read_records(str(p)))
+        assert recs == [b"complete-record"]
+
+
 class TestSummaries:
     def test_train_summary(self, tmp_path):
         s = TrainSummary(str(tmp_path), "app")
