@@ -30,11 +30,12 @@ from bigdl_tpu import dataset
 from bigdl_tpu import parallel
 from bigdl_tpu import utils
 from bigdl_tpu import visualization
+from bigdl_tpu import interop
 
 __version__ = "0.1.0"
 
 __all__ = [
     "Engine", "Table", "T",
-    "nn", "optim", "dataset", "parallel", "utils", "visualization",
+    "nn", "optim", "dataset", "parallel", "utils", "visualization", "interop",
     "__version__",
 ]

@@ -218,18 +218,66 @@ class LocalOptimizer(Optimizer):
         return jax.jit(fwd)
 
     def optimize(self) -> Module:
+        """Train with retry-from-checkpoint (reference
+        ``DistriOptimizer.scala:728-796``): on a non-configuration failure,
+        reload the newest snapshot under ``checkpoint_path`` and retry, up to
+        ``BIGDL_FAILURE_RETRY_TIMES`` (default 5) failures inside a sliding
+        ``BIGDL_FAILURE_RETRY_INTERVAL``-second window (default 120)."""
+        retry_times = int(os.environ.get("BIGDL_FAILURE_RETRY_TIMES", "5"))
+        retry_window = float(
+            os.environ.get("BIGDL_FAILURE_RETRY_INTERVAL", "120"))
+        failures: List[float] = []
+        resume = self._resume_from
+        while True:
+            try:
+                return self._run_training(resume)
+            except (ValueError, TypeError, KeyboardInterrupt):
+                raise  # configuration errors ≙ the reference's IllegalArgument
+            except Exception as e:  # noqa: BLE001 - the retry boundary
+                now = time.time()
+                failures = [t for t in failures if now - t < retry_window]
+                failures.append(now)
+                latest = (self._latest_checkpoint()
+                          if self.checkpoint_path else None)
+                if len(failures) > retry_times or latest is None:
+                    raise
+                resume = latest
+                logger.warning(
+                    "[Retry %d/%d] training failed (%s); restarting from "
+                    "checkpoint %s", len(failures), retry_times, e, latest[0])
+
+    def _latest_checkpoint(self) -> Optional[Tuple[str, str]]:
+        """Newest (model, state) snapshot pair under ``checkpoint_path``
+        (reference ``getLatestFile``, ``DistriOptimizer.scala:808-825``)."""
+        try:
+            names = os.listdir(self.checkpoint_path)
+        except OSError:
+            return None
+        pairs = []
+        for name in names:
+            if name == "model" or name.startswith("model."):
+                state_name = "state" + name[len("model"):]
+                if state_name in names:
+                    path = os.path.join(self.checkpoint_path, name)
+                    pairs.append((os.path.getmtime(path), name, state_name))
+        if not pairs:
+            return None
+        _, model_name, state_name = max(pairs)
+        return (os.path.join(self.checkpoint_path, model_name),
+                os.path.join(self.checkpoint_path, state_name))
+
+    def _run_training(self, resume: Optional[Tuple[str, str]]) -> Module:
         model = self.model
         # Private copies: the jitted step donates its param/buffer inputs, and
         # donating the model's own arrays would delete buffers any other
         # reference (a cloned model, user code) still points at.
         params = jax.tree_util.tree_map(jnp.array, model.parameter_tree())
         buffers = jax.tree_util.tree_map(jnp.array, model.buffer_tree())
-        opt_state = self._init_opt_state(params)
         driver_state = T(epoch=1, neval=1)
         driver_state.update(self.state)
 
-        if self._resume_from:
-            model_path, state_path = self._resume_from
+        if resume:
+            model_path, state_path = resume
             snap = file_io.load(model_path)
             params, buffers = snap["params"], snap["buffers"]
             st = file_io.load(state_path)
@@ -237,6 +285,8 @@ class LocalOptimizer(Optimizer):
             driver_state.update(st["driver"])
             logger.info("[Resume] from %s at epoch %s neval %s", model_path,
                         driver_state["epoch"], driver_state["neval"])
+        else:
+            opt_state = self._init_opt_state(params)
 
         step = self._build_step()
         fwd = self._build_forward()

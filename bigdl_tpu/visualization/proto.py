@@ -131,37 +131,7 @@ def encode_event(wall_time: float, step: Optional[int] = None,
 
 # ------------------------------------------------------------------ decoding
 
-def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
-    result = shift = 0
-    while True:
-        b = buf[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
-
-
-def _iter_fields(buf: bytes) -> Iterator[Tuple[int, int, bytes]]:
-    pos = 0
-    while pos < len(buf):
-        key, pos = _read_varint(buf, pos)
-        field, wt = key >> 3, key & 7
-        if wt == _WT_VARINT:
-            val, pos = _read_varint(buf, pos)
-            yield field, wt, val
-        elif wt == _WT_I64:
-            yield field, wt, buf[pos:pos + 8]
-            pos += 8
-        elif wt == _WT_LEN:
-            n, pos = _read_varint(buf, pos)
-            yield field, wt, buf[pos:pos + n]
-            pos += n
-        elif wt == _WT_I32:
-            yield field, wt, buf[pos:pos + 4]
-            pos += 4
-        else:  # pragma: no cover - unknown wire type
-            raise ValueError(f"unsupported wire type {wt}")
+from bigdl_tpu.utils.protowire import iter_fields as _iter_fields  # noqa: E402
 
 
 def decode_event(buf: bytes) -> dict:

@@ -1,0 +1,274 @@
+"""Torch .t7 and Caffe import/export tests (reference: ``$T``'s TorchFile
+specs and ``load_caffe_test.py``; oracle here is round-trip + forward
+equivalence rather than shelling out to ``th``)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.interop import load_caffe, load_torch, save_torch
+from bigdl_tpu.interop.caffe import CaffeLoader, parse_caffemodel
+from bigdl_tpu.interop.torch_file import (TorchObject, _Reader, _Writer,
+                                          from_torch_object, to_torch_object)
+
+
+def _roundtrip(obj, tmp_path, name="f.t7"):
+    p = str(tmp_path / name)
+    save_torch(obj, p)
+    return p
+
+
+class TestT7Primitives:
+    def test_scalars_and_strings(self, tmp_path):
+        p = str(tmp_path / "prim.t7")
+        for val in (3.5, "hello", True, None):
+            with open(p, "wb") as f:
+                _Writer(f).write_object(val)
+            with open(p, "rb") as f:
+                assert _Reader(f).read_object() == val
+
+    def test_table_with_mixed_keys(self, tmp_path):
+        p = str(tmp_path / "tbl.t7")
+        table = {1: 10.0, 2: "two", "name": "x", 3: {1: 1.0}}
+        with open(p, "wb") as f:
+            _Writer(f).write_object(table)
+        with open(p, "rb") as f:
+            got = _Reader(f).read_object()
+        assert got[1] == 10.0 and got[2] == "two" and got["name"] == "x"
+        assert got[3] == {1: 1.0}
+
+    def test_tensor_roundtrip_dtypes(self, tmp_path):
+        p = str(tmp_path / "tensor.t7")
+        for dtype in (np.float32, np.float64, np.int64, np.uint8):
+            arr = (np.random.RandomState(0).rand(3, 4) * 50).astype(dtype)
+            with open(p, "wb") as f:
+                _Writer(f).write_object(arr)
+            with open(p, "rb") as f:
+                got = _Reader(f).read_object()
+            assert got.dtype == dtype and np.array_equal(got, arr)
+
+    def test_shared_object_written_once(self, tmp_path):
+        arr = np.ones((4,), dtype=np.float32)
+        table = {1: arr, 2: arr}
+        p = str(tmp_path / "shared.t7")
+        with open(p, "wb") as f:
+            _Writer(f).write_object(table)
+        with open(p, "rb") as f:
+            got = _Reader(f).read_object()
+        assert got[1] is got[2]  # back-reference preserved identity
+
+
+class TestT7Modules:
+    def test_linear_roundtrip(self, tmp_path):
+        m = nn.Linear(5, 3)
+        p = _roundtrip(m, tmp_path)
+        m2 = load_torch(p)
+        assert isinstance(m2, nn.Linear)
+        x = np.random.RandomState(1).randn(2, 5).astype(np.float32)
+        assert np.allclose(m.forward(x), m2.forward(x), atol=1e-5)
+
+    def test_lenet_roundtrip_forward_equal(self, tmp_path):
+        from bigdl_tpu.models import lenet
+        m = lenet.build(10)
+        p = _roundtrip(m, tmp_path)
+        m2 = load_torch(p)
+        x = np.random.RandomState(2).randn(2, 28, 28, 1).astype(np.float32)
+        y1 = np.asarray(m.evaluate_mode().forward(x))
+        y2 = np.asarray(m2.evaluate_mode().forward(x))
+        assert np.allclose(y1, y2, atol=1e-4)
+
+    def test_batchnorm_roundtrip(self, tmp_path):
+        m = nn.SpatialBatchNormalization(4)
+        m.running_mean = np.arange(4, dtype=np.float32)
+        m.running_var = 1.0 + np.arange(4, dtype=np.float32)
+        m2 = load_torch(_roundtrip(m, tmp_path))
+        assert isinstance(m2, nn.SpatialBatchNormalization)
+        assert np.allclose(np.asarray(m2.running_mean), np.arange(4))
+        x = np.random.RandomState(3).randn(2, 5, 5, 4).astype(np.float32)
+        assert np.allclose(m.evaluate_mode().forward(x),
+                           m2.evaluate_mode().forward(x), atol=1e-5)
+
+    def test_conv_weight_layout(self, tmp_path):
+        m = nn.SpatialConvolution(3, 8, 5, 5)
+        obj = to_torch_object(m)
+        assert obj["weight"].shape == (8, 3, 5, 5)  # torch OIHW
+        m2 = from_torch_object(obj)
+        assert np.asarray(m2.weight).shape == (5, 5, 3, 8)  # ours HWIO
+        assert np.allclose(np.asarray(m.weight), np.asarray(m2.weight))
+
+    def test_spatial_convolution_mm_2d_weight(self):
+        # nn.SpatialConvolutionMM serializes weight as (O, I*kH*kW)
+        rng = np.random.RandomState(9)
+        w4 = rng.randn(8, 3, 5, 5).astype(np.float64)
+        obj = TorchObject("nn.SpatialConvolutionMM", {
+            "nInputPlane": 3.0, "nOutputPlane": 8.0, "kW": 5.0, "kH": 5.0,
+            "dW": 1.0, "dH": 1.0, "padW": 0.0, "padH": 0.0,
+            "weight": w4.reshape(8, -1), "bias": np.zeros(8)})
+        m = from_torch_object(obj)
+        assert np.asarray(m.weight).shape == (5, 5, 3, 8)
+        assert np.allclose(np.asarray(m.weight),
+                           np.transpose(w4, (2, 3, 1, 0)))
+
+    def test_corrupt_geometry_rejected(self, tmp_path):
+        # header claiming more elements than the storage holds must raise,
+        # not read out-of-bounds memory
+        import struct as st
+        p = str(tmp_path / "corrupt.t7")
+        with open(p, "wb") as f:
+            w = _Writer(f)
+            w.write_int(4)          # TYPE_TORCH
+            w.write_int(1)          # index
+            w.write_string("V 1")
+            w.write_string("torch.FloatTensor")
+            w.write_int(1)          # ndim
+            w.write_long(100)       # size 100 ...
+            w.write_long(1)         # stride
+            w.write_long(1)         # offset
+            w.write_int(4)          # storage: TYPE_TORCH
+            w.write_int(2)
+            w.write_string("V 1")
+            w.write_string("torch.FloatStorage")
+            w.write_long(4)         # ... but only 4 elements
+            f.write(st.pack("<4f", 1, 2, 3, 4))
+        with pytest.raises(ValueError, match="out of bounds"):
+            load_torch(p, as_module=False)
+
+    def test_unmapped_module_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="no .t7 mapping"):
+            to_torch_object(nn.PReLU())
+
+    def test_concat_container(self, tmp_path):
+        m = nn.Sequential().add(
+            nn.ConcatTable().add(nn.Linear(4, 2)).add(nn.Linear(4, 2)))
+        m2 = load_torch(_roundtrip(m, tmp_path))
+        x = np.random.RandomState(4).randn(3, 4).astype(np.float32)
+        y1, y2 = m.forward(x), m2.forward(x)
+        for a, b in zip(y1, y2):
+            assert np.allclose(a, b, atol=1e-5)
+
+
+# ------------------------------------------------------------- caffe fixture
+
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _len_field(field, payload):
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _varint_field(field, value):
+    return _varint(field << 3) + _varint(value)
+
+
+def _blob(arr):
+    shape = b"".join(_varint(d) for d in arr.shape)
+    return (_len_field(7, _len_field(1, shape))
+            + _len_field(5, np.asarray(arr, "<f4").tobytes()))
+
+
+def _make_caffemodel(path, layers, v1=False):
+    """layers: [(name, type, [blobs])]; v1 selects the legacy field layout."""
+    out = b""
+    for name, type_, blobs in layers:
+        if v1:
+            body = (_len_field(4, name.encode())
+                    + _varint_field(5, {"Convolution": 4, "InnerProduct": 14}[type_])
+                    + b"".join(_len_field(6, _blob(b)) for b in blobs))
+            out += _len_field(2, body)
+        else:
+            body = (_len_field(1, name.encode()) + _len_field(2, type_.encode())
+                    + b"".join(_len_field(7, _blob(b)) for b in blobs))
+            out += _len_field(100, body)
+    with open(path, "wb") as f:
+        f.write(out)
+
+
+class TestCaffe:
+    def _model(self):
+        return (nn.Sequential()
+                .add(nn.SpatialConvolution(1, 4, 3, 3).set_name("conv1"))
+                .add(nn.ReLU())
+                .add(nn.Reshape((4 * 26 * 26,)))
+                .add(nn.Linear(4 * 26 * 26, 10).set_name("ip1")))
+
+    def test_parse_and_copy_new_format(self, tmp_path):
+        rng = np.random.RandomState(5)
+        cw = rng.randn(4, 1, 3, 3).astype(np.float32)
+        cb = rng.randn(4).astype(np.float32)
+        lw = rng.randn(10, 4 * 26 * 26).astype(np.float32)
+        lb = rng.randn(10).astype(np.float32)
+        p = str(tmp_path / "net.caffemodel")
+        _make_caffemodel(p, [("conv1", "Convolution", [cw, cb]),
+                             ("ip1", "InnerProduct", [lw, lb])])
+        layers = parse_caffemodel(p)
+        assert [l.name for l in layers] == ["conv1", "ip1"]
+        assert layers[0].blobs[0].shape == (4, 1, 3, 3)
+
+        model = load_caffe(self._model(), p)
+        conv = model.find_module("conv1")
+        assert np.allclose(np.asarray(conv.weight),
+                           np.transpose(cw, (2, 3, 1, 0)))
+        assert np.allclose(np.asarray(conv.bias), cb)
+        ip = model.find_module("ip1")
+        assert np.allclose(np.asarray(ip.weight), lw)
+        assert np.allclose(np.asarray(ip.bias), lb)
+
+    def test_v1_format(self, tmp_path):
+        rng = np.random.RandomState(6)
+        cw = rng.randn(4, 1, 3, 3).astype(np.float32)
+        p = str(tmp_path / "v1.caffemodel")
+        _make_caffemodel(p, [("conv1", "Convolution", [cw])], v1=True)
+        layers = parse_caffemodel(p)
+        assert layers[0].type == "Convolution"
+        assert layers[0].blobs[0].shape == (4, 1, 3, 3)
+
+    def test_match_all_raises_on_missing(self, tmp_path):
+        p = str(tmp_path / "partial.caffemodel")
+        rng = np.random.RandomState(7)
+        _make_caffemodel(p, [("conv1", "Convolution",
+                              [rng.randn(4, 1, 3, 3).astype(np.float32)])])
+        with pytest.raises(ValueError, match="missing weights"):
+            load_caffe(self._model(), p)
+        # partial load allowed with match_all=False
+        model = load_caffe(self._model(), p, match_all=False)
+        assert model is not None
+
+    def test_split_packed_data_concatenated(self, tmp_path):
+        # protobuf allows one packed field split across several LEN records
+        a = np.arange(3, dtype="<f4")
+        b = np.arange(3, 6, dtype="<f4")
+        shape = b"".join(_varint(d) for d in (6,))
+        blob = (_len_field(7, _len_field(1, shape))
+                + _len_field(5, a.tobytes()) + _len_field(5, b.tobytes()))
+        body = (_len_field(1, b"split") + _len_field(2, b"Convolution")
+                + _len_field(7, blob))
+        p = str(tmp_path / "split.caffemodel")
+        with open(p, "wb") as f:
+            f.write(_len_field(100, body))
+        layers = parse_caffemodel(p)
+        assert np.allclose(layers[0].blobs[0], np.arange(6))
+
+    def test_legacy_blob_dims(self, tmp_path):
+        # legacy num/channels/height/width instead of BlobShape
+        arr = np.random.RandomState(8).randn(2, 3, 4, 5).astype(np.float32)
+        payload = (_varint_field(1, 2) + _varint_field(2, 3)
+                   + _varint_field(3, 4) + _varint_field(4, 5)
+                   + _len_field(5, arr.astype("<f4").tobytes()))
+        body = (_len_field(1, b"convX") + _len_field(2, b"Convolution")
+                + _len_field(7, payload))
+        p = str(tmp_path / "legacy.caffemodel")
+        with open(p, "wb") as f:
+            f.write(_len_field(100, body))
+        layers = parse_caffemodel(p)
+        assert layers[0].blobs[0].shape == (2, 3, 4, 5)
