@@ -31,11 +31,13 @@ from bigdl_tpu import parallel
 from bigdl_tpu import utils
 from bigdl_tpu import visualization
 from bigdl_tpu import interop
+from bigdl_tpu import ml
 
 __version__ = "0.1.0"
 
 __all__ = [
     "Engine", "Table", "T",
     "nn", "optim", "dataset", "parallel", "utils", "visualization", "interop",
+    "ml",
     "__version__",
 ]

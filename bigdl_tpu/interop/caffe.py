@@ -43,7 +43,7 @@ _V1_TYPES = {4: "Convolution", 14: "InnerProduct", 39: "Deconvolution",
 def _parse_blob(buf: memoryview) -> np.ndarray:
     shape: List[int] = []
     legacy = [0, 0, 0, 0]  # num, channels, height, width
-    data: Optional[np.ndarray] = None
+    pieces: List[np.ndarray] = []
     for field, wt, val in _iter_fields(buf):
         if field == 7 and wt == _WT_LEN:  # BlobShape
             for f2, w2, v2 in _iter_fields(val):
@@ -59,17 +59,16 @@ def _parse_blob(buf: memoryview) -> np.ndarray:
             legacy[field - 1] = val
         elif field == 5 and wt == _WT_LEN:  # packed float data — protobuf
             # allows one packed field split across several LEN records;
-            # parsers must concatenate
-            piece = np.frombuffer(bytes(val), dtype="<f4")
-            data = piece if data is None else np.concatenate([data, piece])
+            # parsers must concatenate (done once, below)
+            pieces.append(np.frombuffer(bytes(val), dtype="<f4"))
         elif field == 8 and wt == _WT_LEN:  # packed double data
-            piece = np.frombuffer(bytes(val), dtype="<f8").astype(np.float32)
-            data = piece if data is None else np.concatenate([data, piece])
+            pieces.append(np.frombuffer(bytes(val), dtype="<f8")
+                          .astype(np.float32))
         elif field == 5 and wt == _WT_I32:  # unpacked float (rare)
-            piece = np.frombuffer(bytes(val), dtype="<f4")
-            data = piece if data is None else np.concatenate([data, piece])
-    if data is None:
+            pieces.append(np.frombuffer(bytes(val), dtype="<f4"))
+    if not pieces:
         return np.zeros((0,), dtype=np.float32)
+    data = pieces[0] if len(pieces) == 1 else np.concatenate(pieces)
     if not shape and any(legacy):
         shape = [d for d in legacy]
         # legacy blobs are padded with 1s in the leading dims; keep all 4

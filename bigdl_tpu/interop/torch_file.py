@@ -311,7 +311,7 @@ def _conv_to_torch(m) -> TorchObject:
     fields = {
         "nInputPlane": m.n_input_plane, "nOutputPlane": m.n_output_plane,
         "kW": m.kernel_w, "kH": m.kernel_h, "dW": m.stride_w, "dH": m.stride_h,
-        "padW": m.pad_w, "padH": m.pad_h,
+        "padW": m.pad_w, "padH": m.pad_h, "nGroup": m.n_group,
         "weight": w, "gradWeight": np.zeros_like(w),
     }
     if getattr(m, "bias", None) is not None:
@@ -324,17 +324,19 @@ def _conv_to_torch(m) -> TorchObject:
 def _conv_from_torch(obj: TorchObject):
     from bigdl_tpu import nn
     f = obj.fields
+    w = np.asarray(f["weight"], dtype=np.float32)
+    n_group = w.shape[0] if w.ndim == 5 else int(f.get("nGroup", 1))
     m = nn.SpatialConvolution(
         int(f["nInputPlane"]), int(f["nOutputPlane"]),
         int(f["kW"]), int(f["kH"]), int(f["dW"]), int(f["dH"]),
-        int(f.get("padW", 0)), int(f.get("padH", 0)))
-    w = np.asarray(f["weight"], dtype=np.float32)
+        int(f.get("padW", 0)), int(f.get("padH", 0)), n_group=n_group)
     if w.ndim == 5:  # BigDL group layout (G, O/g, I/g, kH, kW) → flatten
         w = w.reshape(-1, *w.shape[2:])
     elif w.ndim == 2:  # nn.SpatialConvolutionMM: (O, I*kH*kW)
-        w = w.reshape(int(f["nOutputPlane"]), int(f["nInputPlane"]),
+        w = w.reshape(int(f["nOutputPlane"]), -1,
                       int(f["kH"]), int(f["kW"]))
-    m.weight = np.transpose(w, (2, 3, 1, 0))  # (O,I,kH,kW)→HWIO
+    # flat (O, I/g, kH, kW) → HWIO (kH, kW, I/g, O), groups preserved
+    m.weight = np.transpose(w, (2, 3, 1, 0))
     if f.get("bias") is not None:
         m.bias = np.asarray(f["bias"], dtype=np.float32)
     return m

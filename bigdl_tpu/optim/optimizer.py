@@ -271,8 +271,6 @@ class LocalOptimizer(Optimizer):
         # Private copies: the jitted step donates its param/buffer inputs, and
         # donating the model's own arrays would delete buffers any other
         # reference (a cloned model, user code) still points at.
-        params = jax.tree_util.tree_map(jnp.array, model.parameter_tree())
-        buffers = jax.tree_util.tree_map(jnp.array, model.buffer_tree())
         driver_state = T(epoch=1, neval=1)
         driver_state.update(self.state)
 
@@ -286,6 +284,8 @@ class LocalOptimizer(Optimizer):
             logger.info("[Resume] from %s at epoch %s neval %s", model_path,
                         driver_state["epoch"], driver_state["neval"])
         else:
+            params = jax.tree_util.tree_map(jnp.array, model.parameter_tree())
+            buffers = jax.tree_util.tree_map(jnp.array, model.buffer_tree())
             opt_state = self._init_opt_state(params)
 
         step = self._build_step()

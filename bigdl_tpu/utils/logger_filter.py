@@ -1,0 +1,54 @@
+"""Logging policy (reference ``utils/LoggerFilter.scala:28``): keep
+``bigdl_tpu.optim`` progress on the console, route chatty runtime/library
+INFO (jax, absl, the reference's spark/akka/breeze equivalents) to a file.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+_DEFAULT_NOISY = ("jax", "absl", "orbax", "flax")
+_configured = False
+
+
+def redirect_logs(log_file: Optional[str] = None,
+                  noisy: Sequence[str] = _DEFAULT_NOISY,
+                  console_level: int = logging.INFO) -> None:
+    """Reference ``LoggerFilter.redirectSparkInfoLogs``: library INFO chatter
+    goes to ``bigdl.log`` (cwd or $BIGDL_LOG_DIR), bigdl_tpu progress logs
+    stay on the console. Idempotent."""
+    global _configured
+    if _configured:
+        return
+    _configured = True
+
+    log_path = log_file or os.path.join(
+        os.environ.get("BIGDL_LOG_DIR", "."), "bigdl.log")
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s", "%H:%M:%S")
+
+    try:
+        file_handler: Optional[logging.Handler] = logging.FileHandler(log_path)
+        file_handler.setFormatter(fmt)
+    except OSError:
+        file_handler = None  # read-only cwd: keep chatter suppressed instead
+
+    for name in noisy:
+        lg = logging.getLogger(name)
+        lg.handlers = [file_handler] if file_handler else []
+        lg.propagate = False
+        lg.setLevel(logging.INFO)
+
+    console = logging.StreamHandler()
+    console.setFormatter(fmt)
+    bt = logging.getLogger("bigdl_tpu")
+    if not bt.handlers:
+        bt.addHandler(console)
+    bt.setLevel(console_level)
+
+
+def reset() -> None:
+    global _configured
+    _configured = False

@@ -45,13 +45,19 @@ def iter_fields(buf: Buf) -> Iterator[Tuple[int, int, Any]]:
             val, pos = read_varint(buf, pos)
             yield field, wt, val
         elif wt == WT_I64:
+            if pos + 8 > n:
+                raise EOFError("truncated fixed64 field")
             yield field, wt, buf[pos:pos + 8]
             pos += 8
         elif wt == WT_LEN:
             ln, pos = read_varint(buf, pos)
+            if pos + ln > n:
+                raise EOFError("truncated length-delimited field")
             yield field, wt, buf[pos:pos + ln]
             pos += ln
         elif wt == WT_I32:
+            if pos + 4 > n:
+                raise EOFError("truncated fixed32 field")
             yield field, wt, buf[pos:pos + 4]
             pos += 4
         else:

@@ -1,0 +1,57 @@
+"""App-main tests (reference strategy §4.5: ``SparkModeSpec.scala:24-42``
+literally invokes the example ``Train.main``s — same idea, minus the cluster)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.apps import autoencoder, lenet, perf, resnet, rnn, vgg
+
+
+class TestTrainMains:
+    def test_lenet_train_then_test(self, tmp_path, capsys):
+        ck = str(tmp_path / "ck")
+        lenet.train(["-b", "64", "-e", "1", "--synthetic-size", "256",
+                     "--checkpoint", ck, "--summary", str(tmp_path / "tb")])
+        assert os.path.exists(os.path.join(ck, "model_final"))
+        # checkpoint + state snapshots written by the trigger
+        assert any(f.startswith("model.") for f in os.listdir(ck))
+        lenet.test(["--model", f"{ck}/model_final",
+                    "--synthetic-size", "128", "-b", "64"])
+        assert "Top1Accuracy" in capsys.readouterr().out
+
+    def test_lenet_resume_flags(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        lenet.train(["-b", "64", "-e", "1", "--synthetic-size", "128",
+                     "--checkpoint", ck, "--overWriteCheckpoint"])
+        lenet.train(["-b", "64", "-e", "2", "--synthetic-size", "128",
+                     "--model", f"{ck}/model", "--state", f"{ck}/state"])
+
+    def test_rnn_train(self):
+        rnn.train(["-b", "8", "-e", "1", "--synthetic-size", "64",
+                   "--hiddenSize", "16", "--sequenceLength", "12"])
+
+    def test_autoencoder_train(self):
+        autoencoder.train(["-b", "32", "-e", "1", "--synthetic-size", "64"])
+
+
+class TestPerfHarness:
+    def test_local_perf_json(self, capsys):
+        perf.main(["--model", "lenet5", "-b", "32", "-i", "3",
+                   "--precision", "fp32"])
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        rec = json.loads(out)
+        assert rec["model"] == "lenet5" and rec["iterations"] == 3
+        assert rec["records_per_sec_incl_compile"] > 0
+
+    def test_distributed_perf(self, capsys):
+        perf.main(["--model", "lenet5", "-b", "64", "-i", "2",
+                   "--distributed", "--precision", "fp32"])
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rec["distributed"] is True and rec["devices"] == 8
+
+    def test_unknown_model_rejected(self):
+        with pytest.raises(SystemExit):
+            perf.main(["--model", "alexnet9000"])

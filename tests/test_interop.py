@@ -98,6 +98,23 @@ class TestT7Modules:
         assert np.asarray(m2.weight).shape == (5, 5, 3, 8)  # ours HWIO
         assert np.allclose(np.asarray(m.weight), np.asarray(m2.weight))
 
+    def test_grouped_conv_roundtrip(self, tmp_path):
+        m = nn.SpatialConvolution(4, 6, 3, 3, n_group=2)
+        m2 = load_torch(_roundtrip(m, tmp_path))
+        assert m2.n_group == 2
+        x = np.random.RandomState(10).randn(2, 8, 8, 4).astype(np.float32)
+        assert np.allclose(m.forward(x), m2.forward(x), atol=1e-5)
+
+    def test_truncated_caffemodel_raises(self, tmp_path):
+        rng = np.random.RandomState(11)
+        cw = rng.randn(4, 1, 3, 3).astype(np.float32)
+        p = str(tmp_path / "trunc.caffemodel")
+        _make_caffemodel(p, [("conv1", "Convolution", [cw])])
+        data = open(p, "rb").read()
+        open(p, "wb").write(data[:len(data) // 2])  # cut mid-blob
+        with pytest.raises(EOFError):
+            parse_caffemodel(p)
+
     def test_spatial_convolution_mm_2d_weight(self):
         # nn.SpatialConvolutionMM serializes weight as (O, I*kH*kW)
         rng = np.random.RandomState(9)

@@ -1,0 +1,54 @@
+"""DLClassifier/DLEstimator tests (reference ``$T``'s DLClassifierSpec:
+transform batches rows and writes predictions)."""
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.ml import DLClassifier, DLModel
+
+
+def _blobs(n=200, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 2).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32) + 1  # classes 1/2
+    x[y == 2] += 1.5
+    return x, y
+
+
+class TestDLModel:
+    def _model(self):
+        m = nn.Sequential().add(nn.Linear(2, 2)).add(nn.LogSoftMax())
+        return m
+
+    def test_transform_shapes_and_tail_batch(self):
+        dm = DLModel(self._model(), batch_size=32)
+        out = dm.transform(np.random.randn(70, 2))
+        assert out.shape == (70, 2)  # 70 % 32 != 0: tail batch padded+sliced
+
+    def test_predict_proba_sums_to_one(self):
+        dm = DLModel(self._model(), batch_size=16)
+        p = dm.predict_proba(np.random.randn(20, 2))
+        assert np.allclose(p.sum(axis=-1), 1.0, atol=1e-5)
+
+    def test_predict_labels_one_based(self):
+        dm = DLModel(self._model(), batch_size=16)
+        pred = dm.predict(np.random.randn(20, 2))
+        assert set(np.unique(pred)).issubset({1, 2})
+
+    def test_feature_shape_reshape(self):
+        m = (nn.Sequential().add(nn.Reshape((4,), batch_mode=True))
+             .add(nn.Linear(4, 2)).add(nn.LogSoftMax()))
+        dm = DLModel(m, batch_size=8, feature_shape=(2, 2))
+        out = dm.transform(np.random.randn(10, 4))
+        assert out.shape == (10, 2)
+
+
+class TestDLClassifierFit:
+    def test_fit_then_predict_separable(self):
+        x, y = _blobs()
+        clf = DLClassifier(
+            nn.Sequential().add(nn.Linear(2, 2)).add(nn.LogSoftMax()),
+            batch_size=50, max_epoch=10, learning_rate=0.5)
+        fitted = clf.fit(x, y)
+        acc = float(np.mean(fitted.predict(x) == y))
+        assert acc > 0.9, f"separable blobs should fit, got {acc}"
