@@ -24,6 +24,7 @@ Public surface mirrors the reference's (``com.intel.analytics.bigdl``):
 
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.tensor import Tensor
 from bigdl_tpu import nn
 from bigdl_tpu import optim
 from bigdl_tpu import dataset
@@ -36,7 +37,7 @@ from bigdl_tpu import ml
 __version__ = "0.1.0"
 
 __all__ = [
-    "Engine", "Table", "T",
+    "Engine", "Table", "T", "Tensor",
     "nn", "optim", "dataset", "parallel", "utils", "visualization", "interop",
     "ml",
     "__version__",

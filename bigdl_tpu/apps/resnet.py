@@ -37,11 +37,14 @@ def _val_set(folder, batch, synthetic_size):
 
 
 def train(argv) -> None:
+    import argparse
     parser = train_parser("bigdl_tpu.apps.resnet train",
                           default_epochs=165, default_lr=0.1)
     parser.add_argument("--depth", type=int, default=20)
     parser.add_argument("--shortcutType", default="A", choices=("A", "B"))
-    parser.add_argument("--nesterov", action="store_true", default=True)
+    parser.add_argument("--nesterov", action=argparse.BooleanOptionalAction,
+                        default=True)
+    parser.set_defaults(weightDecay=1e-4)  # reference Train.scala default
     args = parser.parse_args(argv)
     model = resnet.build_cifar(10, depth=args.depth,
                                shortcut_type=args.shortcutType)
@@ -52,10 +55,12 @@ def train(argv) -> None:
                                 args.synthetic_size),
         methods=[Top1Accuracy()])
     # the reference's Regime schedule (models/resnet/Train.scala):
-    # epochs 1-80: lr, 81-120: lr/10, 121+: lr/100
+    # epochs 1-80: lr, 81-120: lr/10, 121+: lr/100 — hyperparameters come
+    # from the CLI flags, only the schedule is fixed
     opt.set_optim_method(SGD(
-        learningrate=args.learningRate, momentum=0.9, dampening=0.0,
-        nesterov=True, weightdecay=1e-4,
+        learningrate=args.learningRate, momentum=args.momentum,
+        dampening=0.0 if args.nesterov else args.momentum,
+        nesterov=args.nesterov, weightdecay=args.weightDecay,
         learningrate_schedule=EpochSchedule([
             Regime(1, 80, T(learningRate=args.learningRate)),
             Regime(81, 120, T(learningRate=args.learningRate / 10)),

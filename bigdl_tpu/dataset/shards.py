@@ -1,0 +1,92 @@
+"""Packed record shards — the ImageNet-scale ingest path (reference
+``DataSet.SeqFileFolder.files`` + ``ImageNetSeqFileGenerator.scala``: bulk
+image bytes packed into Hadoop SequenceFiles so training never stats millions
+of small files).
+
+TPU-native form: plain local shard files with TFRecord-style framing (length +
+masked CRC32C + payload, via ``visualization.tensorboard.RecordWriter``) —
+one record per (label, bytes) pair. No Hadoop dependency; per-host shard
+assignment replaces HDFS locality (each host of a multi-host pod reads its
+own shard subset, ≙ ``CachedDistriDataSet`` partition pinning)."""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional, Sequence
+
+from bigdl_tpu.dataset.base import ByteRecord, DataSet, LocalDataSet
+from bigdl_tpu.visualization.tensorboard import FileReader, RecordWriter
+
+_SUFFIX = ".bigdl-shard"
+
+
+class ShardWriter:
+    """Write (label, payload) records into fixed-size shard files
+    (reference ``BGRImgToLocalSeqFile``)."""
+
+    def __init__(self, path_prefix: str, records_per_shard: int = 1024):
+        self.path_prefix = path_prefix
+        self.records_per_shard = records_per_shard
+        self._shard_idx = 0
+        self._in_shard = 0
+        self._file = None
+        self._writer: Optional[RecordWriter] = None
+        os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+
+    def _roll(self) -> None:
+        if self._file is not None:
+            self._file.close()
+        path = f"{self.path_prefix}-{self._shard_idx:05d}{_SUFFIX}"
+        self._file = open(path, "wb")
+        self._writer = RecordWriter(self._file)
+        self._shard_idx += 1
+        self._in_shard = 0
+
+    def write(self, label: float, payload: bytes) -> None:
+        if self._writer is None or self._in_shard >= self.records_per_shard:
+            self._roll()
+        self._writer.write(struct.pack("<f", float(label)) + payload)
+        self._in_shard += 1
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "ShardWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def list_shards(folder: str) -> List[str]:
+    return sorted(os.path.join(folder, f) for f in os.listdir(folder)
+                  if f.endswith(_SUFFIX))
+
+
+def read_shard(path: str) -> Iterator[ByteRecord]:
+    for record in FileReader.read_records(path):
+        (label,) = struct.unpack("<f", record[:4])
+        yield ByteRecord(record[4:], label)
+
+
+class ShardFolder:
+    """reference ``SeqFileFolder.files``: a DataSet over shard files."""
+
+    @staticmethod
+    def paths(folder: str, host_index: int = 0,
+              host_count: int = 1) -> List[str]:
+        """Shards for this host — round-robin split across hosts (the
+        multi-host ingest layout: each host feeds its local chips only)."""
+        shards = list_shards(folder)
+        return shards[host_index::host_count]
+
+    @staticmethod
+    def files(folder: str, host_index: int = 0,
+              host_count: int = 1) -> LocalDataSet:
+        records: List[ByteRecord] = []
+        for path in ShardFolder.paths(folder, host_index, host_count):
+            records.extend(read_shard(path))
+        return DataSet.array(records)

@@ -19,10 +19,14 @@ class DLModel:
     (reference ``DLClassifier.process`` batching loop — vectorized here)."""
 
     def __init__(self, model: Module, batch_size: int = 128,
-                 feature_shape: Optional[Sequence[int]] = None):
+                 feature_shape: Optional[Sequence[int]] = None,
+                 log_prob_head: bool = True):
         self.model = model
         self.batch_size = batch_size
         self.feature_shape = tuple(feature_shape) if feature_shape else None
+        # the framework's classifier heads end in LogSoftMax; set False when
+        # wrapping a model whose head already emits probabilities
+        self.log_prob_head = log_prob_head
         self._fwd = None
 
     def _forward(self, params, buffers, x):
@@ -60,11 +64,11 @@ class DLModel:
 
     # sklearn aliases
     def predict_proba(self, features: np.ndarray) -> np.ndarray:
+        """Probabilities. The representation is fixed by ``log_prob_head``
+        at construction — never inferred from the data, so the output scale
+        is stable across batches."""
         out = self.transform(features)
-        # log-prob heads (LogSoftMax) → probabilities
-        if np.all(out <= 1e-6):
-            return np.exp(out)
-        return out
+        return np.exp(out) if self.log_prob_head else out
 
     def predict(self, features: np.ndarray) -> np.ndarray:
         """1-based class ids, matching the framework's label convention."""

@@ -58,6 +58,7 @@ from bigdl_tpu.nn.regularization import (
     L1L2Regularizer,
 )
 from bigdl_tpu.nn.graph import Graph, Input, Node
+from bigdl_tpu.nn.detection import Nms, nms
 from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTM, LSTMPeephole, GRU, Recurrent, RecurrentDecoder,
     BiRecurrent, TimeDistributed,

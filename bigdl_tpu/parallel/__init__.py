@@ -19,3 +19,4 @@ from bigdl_tpu.parallel.pipeline import (
 from bigdl_tpu.parallel.expert import MoE, expert_param_specs, inject_loss
 from bigdl_tpu.parallel.compression import (
     CompressedTensor, SerializerInstance, fp32_to_bf16, bf16_to_fp32)
+from bigdl_tpu.parallel.model_broadcast import ModelBroadcast

@@ -10,22 +10,24 @@ import os
 from typing import Optional, Sequence
 
 _DEFAULT_NOISY = ("jax", "absl", "orbax", "flax")
-_configured = False
+_configured_path: Optional[str] = None
 
 
 def redirect_logs(log_file: Optional[str] = None,
                   noisy: Sequence[str] = _DEFAULT_NOISY,
                   console_level: int = logging.INFO) -> None:
     """Reference ``LoggerFilter.redirectSparkInfoLogs``: library INFO chatter
-    goes to ``bigdl.log`` (cwd or $BIGDL_LOG_DIR), bigdl_tpu progress logs
-    stay on the console. Idempotent."""
-    global _configured
-    if _configured:
-        return
-    _configured = True
-
+    goes to ``bigdl.log`` under $BIGDL_LOG_DIR (default: the system temp dir,
+    NOT the cwd — app mains must not litter the caller's directory);
+    bigdl_tpu progress logs stay on the console. Re-invoking with the same
+    (or no) target is a no-op; a different ``log_file`` re-routes."""
+    global _configured_path
+    import tempfile
     log_path = log_file or os.path.join(
-        os.environ.get("BIGDL_LOG_DIR", "."), "bigdl.log")
+        os.environ.get("BIGDL_LOG_DIR", tempfile.gettempdir()), "bigdl.log")
+    if _configured_path is not None and _configured_path == log_path:
+        return
+    _configured_path = log_path
     fmt = logging.Formatter(
         "%(asctime)s %(levelname)s %(name)s: %(message)s", "%H:%M:%S")
 
@@ -50,5 +52,5 @@ def redirect_logs(log_file: Optional[str] = None,
 
 
 def reset() -> None:
-    global _configured
-    _configured = False
+    global _configured_path
+    _configured_path = None

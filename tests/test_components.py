@@ -1,0 +1,112 @@
+"""Tests for the smaller parity components: Nms, shard ingest, ModelBroadcast,
+kth_largest (reference ``nn/Nms.scala``, ``SeqFileFolder``,
+``ModelBroadcast.scala:33``, ``Util.scala:20``)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset.shards import (ShardFolder, ShardWriter, list_shards,
+                                      read_shard)
+from bigdl_tpu.parallel.model_broadcast import ModelBroadcast
+from bigdl_tpu.utils import kth_largest
+
+
+class TestNms:
+    def test_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10],
+                          [1, 1, 10, 10],    # heavy overlap with box 0
+                          [20, 20, 30, 30]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        idx, count = nn.nms(boxes, scores, threshold=0.5, max_output=3)
+        assert int(count) == 2
+        kept = [int(i) for i in np.asarray(idx) if i >= 0]
+        assert kept == [0, 2]  # best-first, overlap suppressed
+
+    def test_module_one_based_padded(self):
+        m = nn.Nms(threshold=0.5, max_output=4)
+        boxes = np.array([[0, 0, 1, 1], [5, 5, 6, 6]], np.float32)
+        scores = np.array([0.5, 0.9], np.float32)
+        out = np.asarray(m.update_output(boxes, scores))
+        assert out.shape == (4,)
+        assert list(out[:2]) == [2, 1]  # 1-based, score order
+        assert list(out[2:]) == [0, 0]  # padding
+
+    def test_threshold_keeps_all(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 10, 10]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        _, count = nn.nms(boxes, scores, threshold=0.95, max_output=4)
+        assert int(count) == 2
+
+
+class TestShards:
+    def test_write_read_roundtrip(self, tmp_path):
+        prefix = str(tmp_path / "imagenet" / "train")
+        with ShardWriter(prefix, records_per_shard=3) as w:
+            for i in range(8):
+                w.write(float(i % 4 + 1), bytes([i] * 10))
+        shards = list_shards(str(tmp_path / "imagenet"))
+        assert len(shards) == 3  # 3+3+2
+        records = [r for s in shards for r in read_shard(s)]
+        assert len(records) == 8
+        assert records[0].label == 1.0 and records[0].data == bytes([0] * 10)
+
+    def test_host_sharding_partition(self, tmp_path):
+        prefix = str(tmp_path / "d" / "part")
+        with ShardWriter(prefix, records_per_shard=2) as w:
+            for i in range(8):
+                w.write(1.0, b"x")
+        all_paths = ShardFolder.paths(str(tmp_path / "d"))
+        h0 = ShardFolder.paths(str(tmp_path / "d"), 0, 2)
+        h1 = ShardFolder.paths(str(tmp_path / "d"), 1, 2)
+        assert sorted(h0 + h1) == all_paths and not set(h0) & set(h1)
+
+    def test_files_dataset(self, tmp_path):
+        prefix = str(tmp_path / "d" / "part")
+        with ShardWriter(prefix) as w:
+            for i in range(5):
+                w.write(float(i + 1), b"abc")
+        ds = ShardFolder.files(str(tmp_path / "d"))
+        assert ds.size() == 5
+
+
+class TestModelBroadcast:
+    def test_value_device_resident(self):
+        import jax
+        m = nn.Sequential().add(nn.Linear(4, 2))
+        mb = ModelBroadcast(m)
+        model, params, buffers = mb.value()
+        assert model is m
+        leaf = jax.tree_util.tree_leaves(params)[0]
+        assert isinstance(leaf, jax.Array)
+
+    def test_predictor_from_broadcast(self):
+        m = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+        pred = ModelBroadcast(m).predictor(batch_size=8)
+        from bigdl_tpu.dataset.base import Sample
+        samples = [Sample(np.random.randn(4).astype(np.float32),
+                          np.float32(1)) for _ in range(8)]
+        outs = pred.predict(samples)
+        assert np.asarray(outs[0]).shape == (8, 2)
+
+    def test_mesh_replication(self):
+        import jax
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        m = nn.Sequential().add(nn.Linear(4, 2))
+        _, params, _ = ModelBroadcast(m, mesh).value()
+        leaf = jax.tree_util.tree_leaves(params)[0]
+        assert leaf.sharding.is_fully_replicated
+
+
+class TestKthLargest:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        vals = rng.randn(101)
+        for k in (1, 5, 50, 101):
+            assert kth_largest(vals, k) == pytest.approx(
+                np.sort(vals)[::-1][k - 1])
+
+    def test_bounds(self):
+        with pytest.raises(ValueError):
+            kth_largest([1.0], 2)

@@ -1,0 +1,184 @@
+"""Tensor façade tests (reference ``$T/tensor/DenseTensorSpec.scala`` and the
+TensorMath specs — 1-based Torch semantics over jax.Array)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.tensor import Storage, Tensor
+
+
+class TestStructure:
+    def test_construct_by_sizes(self):
+        t = Tensor(2, 3)
+        assert t.size() == (2, 3) and t.dim() == 2 and t.n_element() == 6
+
+    def test_construct_from_data(self):
+        t = Tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.size() == (2, 2)
+        assert t[1, 2] == 2.0  # 1-based apply
+
+    def test_size_dim_one_based(self):
+        t = Tensor(4, 5, 6)
+        assert t.size(1) == 4 and t.size(3) == 6
+        with pytest.raises(IndexError):
+            t.size(4)
+        with pytest.raises(IndexError):
+            t.size(0)
+
+    def test_select_narrow(self):
+        t = Tensor(np.arange(12).reshape(3, 4))
+        s = t.select(1, 2)  # second row
+        assert np.allclose(s.numpy(), [4, 5, 6, 7])
+        n = t.narrow(2, 2, 2)  # cols 2..3
+        assert np.allclose(n.numpy(), [[1, 2], [5, 6], [9, 10]])
+
+    def test_view_transpose_t(self):
+        t = Tensor(np.arange(6).reshape(2, 3))
+        assert t.view(3, 2).size() == (3, 2)
+        assert t.transpose(1, 2).size() == (3, 2)
+        assert np.allclose(t.t().numpy(), t.numpy().T)
+
+    def test_squeeze_unsqueeze_expand(self):
+        t = Tensor(1, 3, 1)
+        assert t.squeeze().size() == (3,)
+        assert t.squeeze(1).size() == (3, 1)
+        assert t.unsqueeze(1).size() == (1, 1, 3, 1)
+        e = Tensor([[1.0], [2.0]]).expand(2, 4)
+        assert e.size() == (2, 4) and e[2, 4] == 2.0
+
+
+class TestMutation:
+    def test_fill_zero(self):
+        t = Tensor(2, 2).fill(7.0)
+        assert t.sum() == 28.0
+        assert t.zero().sum() == 0.0
+
+    def test_copy_reshapes(self):
+        t = Tensor(2, 3)
+        t.copy(Tensor(np.arange(6, dtype=np.float32)))
+        assert t[2, 3] == 5.0
+        with pytest.raises(ValueError):
+            t.copy(Tensor(np.arange(5, dtype=np.float32)))
+
+    def test_resize_preserves_prefix(self):
+        t = Tensor(np.arange(6, dtype=np.float32))
+        t.resize(2, 2)
+        assert np.allclose(t.numpy(), [[0, 1], [2, 3]])
+        t.resize(8)
+        assert t.n_element() == 8 and float(t.numpy()[-1]) == 0.0
+
+    def test_set_value(self):
+        t = Tensor(2, 2)
+        t.set_value(1, 2, 9.0)
+        assert t[1, 2] == 9.0
+
+    def test_inplace_math_returns_self(self):
+        t = Tensor([[1.0, 2.0]])
+        assert t.add(1.0) is t
+        assert np.allclose(t.numpy(), [[2, 3]])
+        t.add(2.0, Tensor([[1.0, 1.0]]))  # add(scalar, tensor)
+        assert np.allclose(t.numpy(), [[4, 5]])
+        t.mul(2.0).div(4.0)
+        assert np.allclose(t.numpy(), [[2, 2.5]])
+
+
+class TestMath:
+    def test_reductions(self):
+        t = Tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.sum() == 10.0 and t.mean() == 2.5
+        assert t.max() == 4.0 and t.min() == 1.0
+        col_sum = t.sum(1)
+        assert col_sum.size() == (1, 2)
+        assert np.allclose(col_sum.numpy(), [[4, 6]])
+
+    def test_max_with_dim_returns_one_based_indices(self):
+        t = Tensor([[1.0, 5.0], [7.0, 3.0]])
+        values, indices = t.max(2)
+        assert np.allclose(values.numpy().ravel(), [5, 7])
+        assert np.allclose(indices.numpy().ravel(), [2, 1])  # 1-based
+
+    def test_matmul_family(self):
+        a = Tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        b = Tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        out = Tensor(2, 2).mm(a, b)
+        assert np.allclose(out.numpy(), a.numpy() @ b.numpy())
+        v = Tensor(np.ones(3, dtype=np.float32))
+        assert np.allclose(Tensor(2).mv(a, v).numpy(), a.numpy().sum(1))
+        assert Tensor([1.0, 2.0]).dot(Tensor([3.0, 4.0])) == 11.0
+
+    def test_addmm(self):
+        m = Tensor(np.ones((2, 2), np.float32))
+        a = Tensor(np.eye(2, dtype=np.float32))
+        b = Tensor(np.full((2, 2), 2.0, np.float32))
+        out = Tensor(2, 2).addmm(0.5, m, 2.0, a, b)
+        assert np.allclose(out.numpy(), 0.5 + 2.0 * (a.numpy() @ b.numpy()))
+
+    def test_elementwise_chains(self):
+        t = Tensor([4.0, 9.0]).sqrt()
+        assert np.allclose(t.numpy(), [2, 3])
+        assert np.allclose(Tensor([1.0, 2.0]).pow(2).numpy(), [1, 4])
+        assert np.allclose(Tensor([-1.0, 2.0]).abs().numpy(), [1, 2])
+        assert Tensor([3.0, 4.0]).norm(2) == pytest.approx(5.0)
+
+    def test_operators_not_inplace(self):
+        t = Tensor([1.0, 2.0])
+        u = t + 1
+        assert np.allclose(t.numpy(), [1, 2]) and np.allclose(u.numpy(), [2, 3])
+        assert np.allclose((2 * t).numpy(), [2, 4])
+        assert np.allclose((t - 1).numpy(), [0, 1])
+        assert np.allclose((-t).numpy(), [-1, -2])
+
+
+class TestStorageAndInterop:
+    def test_storage_one_based(self):
+        t = Tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+        s = t.storage()
+        assert len(s) == 4 and s[1] == 0.0 and s[4] == 3.0
+
+    def test_set_storage_writes_back(self):
+        t = Tensor(2, 2)
+        s = t.storage()
+        s[3] = 5.0
+        t.set_storage(s)
+        assert t[2, 1] == 5.0
+
+    def test_index_select_one_based(self):
+        t = Tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+        got = t.index_select(1, [3, 1])
+        assert np.allclose(got.numpy(), [[6, 7, 8], [0, 1, 2]])
+
+    def test_equality_and_clone(self):
+        t = Tensor([1.0, 2.0])
+        c = t.clone()
+        assert t == c
+        c.add(1.0)
+        assert not (t == c)  # clone does not alias
+
+    def test_range_inclusive(self):
+        assert np.allclose(Tensor.range(1, 5).numpy(), [1, 2, 3, 4, 5])
+        assert np.allclose(Tensor.range(0, 1, 0.5).numpy(), [0, 0.5, 1.0])
+
+    def test_rng_fills(self):
+        from bigdl_tpu.utils.rng import manual_seed
+        manual_seed(3)
+        t = Tensor(100).rand()
+        assert 0.0 <= t.min() and t.max() <= 1.0
+        b = Tensor(1000).bernoulli(0.3)
+        assert 0.2 < b.mean() < 0.4
+
+    def test_dtype_preserved_through_ops(self):
+        # regression: integer index tensors must not decay to float32
+        import jax.numpy as jnp
+        t = Tensor(np.arange(6, dtype=np.int32).reshape(2, 3))
+        assert t.data.dtype == jnp.int32
+        assert t.clone().data.dtype == jnp.int32
+        assert t.view(3, 2).data.dtype == jnp.int32
+        assert t.select(1, 1).data.dtype == jnp.int32
+        _, idx = Tensor([[1.0, 5.0]]).max(2)
+        assert idx.clone().data.dtype == jnp.int32
+        d = Tensor(np.ones(3, dtype=np.float64))
+        assert (d + 1).data.dtype == d.data.dtype
+
+    def test_apply1(self):
+        t = Tensor([1.0, 2.0]).apply1(lambda x: x * 10)
+        assert np.allclose(t.numpy(), [10, 20])
