@@ -53,6 +53,7 @@ class ShardWriter:
         if self._file is not None:
             self._file.close()
             self._file = None
+        self._writer = None  # a later write() rolls a fresh shard
 
     def __enter__(self) -> "ShardWriter":
         return self

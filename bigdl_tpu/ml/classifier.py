@@ -83,7 +83,7 @@ class DLEstimator:
     def __init__(self, model: Module, criterion, batch_size: int = 128,
                  max_epoch: int = 5, learning_rate: float = 0.01,
                  feature_shape: Optional[Sequence[int]] = None,
-                 optim_method=None):
+                 optim_method=None, log_prob_head: bool = True):
         self.model = model
         self.criterion = criterion
         self.batch_size = batch_size
@@ -91,6 +91,7 @@ class DLEstimator:
         self.learning_rate = learning_rate
         self.feature_shape = tuple(feature_shape) if feature_shape else None
         self.optim_method = optim_method
+        self.log_prob_head = log_prob_head
 
     def fit(self, features: np.ndarray, labels: np.ndarray) -> DLModel:
         from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
@@ -108,7 +109,8 @@ class DLEstimator:
                              or SGD(learningrate=self.learning_rate))
         opt.set_end_when(Trigger.max_epoch(self.max_epoch))
         trained = opt.optimize()
-        return DLModel(trained, self.batch_size, self.feature_shape)
+        return DLModel(trained, self.batch_size, self.feature_shape,
+                       log_prob_head=self.log_prob_head)
 
 
 class DLClassifier(DLEstimator):

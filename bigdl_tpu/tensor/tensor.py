@@ -34,11 +34,17 @@ class Storage:
     def __len__(self) -> int:
         return self._data.size
 
+    def _check(self, i: int) -> int:
+        if not 1 <= i <= self._data.size:
+            raise IndexError(f"storage index {i} out of range "
+                             f"[1, {self._data.size}] (1-based)")
+        return i - 1
+
     def __getitem__(self, i: int) -> Scalar:
-        return self._data[i - 1]  # 1-based, as the reference's Storage
+        return self._data[self._check(i)]  # 1-based, as the reference
 
     def __setitem__(self, i: int, v: Scalar) -> None:
-        self._data[i - 1] = v
+        self._data[self._check(i)] = v
 
     def array(self) -> np.ndarray:
         return self._data
@@ -97,6 +103,14 @@ class Tensor:
                              f"{self.data.ndim}-d tensor (1-based)")
         return d - 1
 
+    @staticmethod
+    def _index(i: int, size: int, what: str = "index") -> int:
+        """Validate a 1-based index — Torch raises on 0/out-of-range; jnp
+        would silently clip/wrap, corrupting results."""
+        if not 1 <= i <= size:
+            raise IndexError(f"{what} {i} out of range [1, {size}] (1-based)")
+        return i - 1
+
     def is_same_size_as(self, other: "Tensor") -> bool:
         return self.data.shape == other.data.shape
 
@@ -109,13 +123,19 @@ class Tensor:
     # ------------------------------------------------------------- indexing
     def select(self, dim: int, index: int) -> "Tensor":
         """Drop ``dim`` at 1-based ``index`` (reference ``select``)."""
-        return Tensor(jnp.take(self.data, index - 1, axis=self._dim(dim)))
+        ax = self._dim(dim)
+        return Tensor(jnp.take(
+            self.data, self._index(index, self.data.shape[ax]), axis=ax))
 
     def narrow(self, dim: int, index: int, size: int) -> "Tensor":
         """Slice [index, index+size) on ``dim`` (1-based)."""
         ax = self._dim(dim)
+        start = self._index(index, self.data.shape[ax])
+        if start + size > self.data.shape[ax]:
+            raise IndexError(f"narrow({dim},{index},{size}) exceeds size "
+                             f"{self.data.shape[ax]}")
         sl = [slice(None)] * self.data.ndim
-        sl[ax] = slice(index - 1, index - 1 + size)
+        sl[ax] = slice(start, start + size)
         return Tensor(self.data[tuple(sl)])
 
     def view(self, *sizes: int) -> "Tensor":
@@ -154,8 +174,12 @@ class Tensor:
         return Tensor(jnp.tile(self.data, sizes))
 
     def index_select(self, dim: int, indices) -> "Tensor":
-        idx = _promote(indices).astype(jnp.int32) - 1
-        return Tensor(jnp.take(self.data, idx, axis=self._dim(dim)))
+        ax = self._dim(dim)
+        idx = np.asarray(_promote(indices)).astype(np.int64)
+        if idx.size and (idx.min() < 1 or idx.max() > self.data.shape[ax]):
+            raise IndexError(f"index_select indices out of range "
+                             f"[1, {self.data.shape[ax]}] (1-based)")
+        return Tensor(jnp.take(self.data, jnp.asarray(idx - 1), axis=ax))
 
     def masked_select(self, mask) -> "Tensor":
         m = np.asarray(_promote(mask)).astype(bool)
@@ -165,17 +189,19 @@ class Tensor:
         """1-based scalar/select indexing like the reference's ``apply``."""
         if isinstance(idx, int):
             if self.data.ndim == 1:
-                return float(self.data[idx - 1])
+                return float(self.data[self._index(idx, self.data.shape[0])])
             return self.select(1, idx)
         if isinstance(idx, tuple) and all(isinstance(i, int) for i in idx):
-            zero_based = tuple(i - 1 for i in idx)
+            zero_based = tuple(self._index(i, s) for i, s in
+                               zip(idx, self.data.shape))
             return float(self.data[zero_based])
         raise TypeError("Tensor indexing is 1-based ints (Torch apply "
                         "semantics); use .data for numpy-style slicing")
 
     def set_value(self, *args) -> "Tensor":
         *idx, value = args
-        zero_based = tuple(i - 1 for i in idx)
+        zero_based = tuple(self._index(i, s) for i, s in
+                           zip(idx, self.data.shape))
         self.data = self.data.at[zero_based].set(value)
         return self
 
@@ -294,11 +320,8 @@ class Tensor:
             return float(jnp.max(self.data))
         ax = self._dim(dim)
         values = jnp.max(self.data, axis=ax, keepdims=True)
-        indices = (jnp.argmax(self.data, axis=ax) + 1)[None] \
-            if self.data.ndim == 1 else \
-            jnp.expand_dims(jnp.argmax(self.data, axis=ax) + 1, ax)
-        return Tensor(values), Tensor(indices.astype(jnp.int32),
-                                      dtype=jnp.int32)
+        indices = jnp.expand_dims(jnp.argmax(self.data, axis=ax) + 1, ax)
+        return Tensor(values), Tensor(indices.astype(jnp.int32))
 
     def min(self, dim: Optional[int] = None):
         if dim is None:
@@ -306,8 +329,7 @@ class Tensor:
         ax = self._dim(dim)
         values = jnp.min(self.data, axis=ax, keepdims=True)
         indices = jnp.expand_dims(jnp.argmin(self.data, axis=ax) + 1, ax)
-        return Tensor(values), Tensor(indices.astype(jnp.int32),
-                                      dtype=jnp.int32)
+        return Tensor(values), Tensor(indices.astype(jnp.int32))
 
     def norm(self, p: Scalar = 2) -> float:
         if p == 1:
@@ -328,19 +350,25 @@ class Tensor:
 
     def addmm(self, *args) -> "Tensor":
         """addmm([beta,] [M,] [alpha,] mat1, mat2): β·M + α·mat1@mat2
-        (reference ``TensorMath.addmm`` overload family)."""
-        beta, alpha = 1.0, 1.0
+        (reference ``TensorMath.addmm`` overload family). Overloads are
+        resolved by scalar-vs-tensor TYPE, not just arity — a leading scalar
+        is β, a leading tensor is M."""
+        beta, alpha, m = 1.0, 1.0, self
         rest = list(args)
-        m = self
-        if len(rest) == 5:
-            beta, m, alpha, mat1, mat2 = rest
-        elif len(rest) == 4:
-            beta, mat1, mat2 = rest[0], rest[2], rest[3]
-            alpha = rest[1]
-        elif len(rest) == 3:
-            m, mat1, mat2 = rest
-        else:
-            mat1, mat2 = rest
+
+        def is_scalar(x):
+            return isinstance(x, (int, float, np.floating, np.integer))
+
+        mat1, mat2 = rest[-2], rest[-1]
+        head = rest[:-2]
+        if head and is_scalar(head[0]):
+            beta = head.pop(0)
+        if head and not is_scalar(head[0]):
+            m = head.pop(0)
+        if head and is_scalar(head[0]):
+            alpha = head.pop(0)
+        if head:
+            raise TypeError(f"unsupported addmm argument shape {args!r}")
         self.data = (beta * _promote(m)
                      + alpha * jnp.matmul(_promote(mat1), _promote(mat2)))
         return self

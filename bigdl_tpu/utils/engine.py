@@ -119,6 +119,65 @@ class Engine:
         return _state._io_pool
 
     @staticmethod
+    def check_singleton() -> bool:
+        """One training process per host (reference ``Engine.checkSingleton``,
+        ``utils/Engine.scala:160`` — there a JVM-wide flag; here an exclusive
+        host lock file keyed by $BIGDL_SINGLETON_DIR). Returns True when this
+        process holds (or just acquired) the claim; False when another live
+        process holds it. Disabled unless BIGDL_CHECK_SINGLETON=1, matching
+        the reference's ``bigdl.check.singleton`` property."""
+        import os
+        if os.environ.get("BIGDL_CHECK_SINGLETON", "0") != "1":
+            return True
+        import tempfile
+        lock_dir = os.environ.get("BIGDL_SINGLETON_DIR",
+                                  tempfile.gettempdir())
+        path = os.path.join(lock_dir, "bigdl_tpu.singleton.lock")
+        pid = os.getpid()
+
+        def try_claim() -> bool:
+            # write pid to a private file, then hard-link it into place —
+            # link(2) is atomic, so exactly one contender wins and the lock
+            # file is never observable with partial/empty contents
+            tmp = f"{path}.{pid}"
+            try:
+                with open(tmp, "w") as f:
+                    f.write(str(pid))
+                os.link(tmp, path)
+                return True
+            except FileExistsError:
+                return False
+            finally:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+        if try_claim():
+            return True
+        try:
+            holder = int(open(path).read().strip() or "0")
+        except (OSError, ValueError):
+            holder = 0
+        if holder == pid:
+            return True
+        if holder:
+            try:
+                os.kill(holder, 0)  # probe liveness
+                return False  # live holder
+            except ProcessLookupError:
+                pass  # stale lock from a dead process — take it over
+            except PermissionError:
+                return False  # live process of another user holds it
+        else:
+            return False  # unreadable/foreign lock: don't steal
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return try_claim()  # only one stale-lock contender wins the link
+
+    @staticmethod
     def reset() -> None:
         """Forget topology (test hook, analogue of re-running Engine.init)."""
         with _state._lock:

@@ -39,14 +39,19 @@ def redirect_logs(log_file: Optional[str] = None,
 
     for name in noisy:
         lg = logging.getLogger(name)
+        for h in lg.handlers:  # close replaced handlers (re-route support)
+            try:
+                h.close()
+            except Exception:
+                pass
         lg.handlers = [file_handler] if file_handler else []
         lg.propagate = False
         lg.setLevel(logging.INFO)
 
-    console = logging.StreamHandler()
-    console.setFormatter(fmt)
     bt = logging.getLogger("bigdl_tpu")
     if not bt.handlers:
+        console = logging.StreamHandler()
+        console.setFormatter(fmt)
         bt.addHandler(console)
     bt.setLevel(console_level)
 
