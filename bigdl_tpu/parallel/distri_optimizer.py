@@ -28,6 +28,15 @@ to its top 16 bits == bfloat16) maps to casting the collective payload to
 BatchNorm note: in allreduce mode batch-stat means over the sharded batch are
 computed globally by XLA → synchronized BN across replicas (an upgrade over
 the reference's per-replica stats); in sharded mode new buffers are pmean'd.
+
+Multi-host: when ``Engine.init`` joined a jax.distributed topology (env
+``BIGDL_COORDINATOR_ADDRESS``/..., or TPU-pod auto-detect), the same jitted
+step spans every host's chips. Per-process ingest (``DistributedDataSet``
+record slices ≙ executor-pinned partitions) feeds
+``jax.make_array_from_process_local_data``; state is committed to the global
+mesh by ``_place_state``; checkpoints gather sharded leaves and write on
+process 0 only; validation merges per-host (numerator, count) pairs with one
+allgather. Verified by ``tests/test_multihost.py`` (2 real processes, gloo).
 """
 
 from __future__ import annotations
@@ -74,9 +83,118 @@ class DistriOptimizer(LocalOptimizer):
 
     # ------------------------------------------------------------- placement
     def _place_batch(self, batch):
+        """Commit one batch onto the mesh's data axis.
+
+        Single-host: the pipeline's batch IS the global batch — device_put
+        shards it. Multi-host: the pipeline yields this process's LOCAL
+        records only (``DistributedDataSet`` per-process slice ≙ the
+        reference's executor-pinned partitions, ``CachedDistriDataSet``);
+        ``jax.make_array_from_process_local_data`` assembles the global
+        array without any host ever holding the full batch."""
+        if jax.process_count() > 1:
+            data = jax.make_array_from_process_local_data(
+                self._batch_sharding, np.asarray(batch.data))
+            labels = jax.make_array_from_process_local_data(
+                self._batch_sharding, np.asarray(batch.labels))
+            return data, labels
         data = jax.device_put(jnp.asarray(batch.data), self._batch_sharding)
         labels = jax.device_put(jnp.asarray(batch.labels), self._batch_sharding)
         return data, labels
+
+    def _place_state(self, params, buffers, opt_state):
+        """Commit training state onto the mesh (multi-host: host-local values
+        become global arrays; required before jit sees cross-process
+        shardings)."""
+        if jax.process_count() <= 1:
+            return params, buffers, opt_state
+        rep = self._replicated
+
+        def put_rep(x):
+            return jax.device_put(jnp.asarray(x), rep)
+
+        n_params = sum(int(np.size(l))
+                       for l in jax.tree_util.tree_leaves(params))
+        full = n_params + ((-n_params) % self._n_data)
+        params = jax.tree_util.tree_map(put_rep, params)
+        buffers = jax.tree_util.tree_map(put_rep, buffers)
+        if self.sync_mode != "sharded":
+            opt_state = jax.tree_util.tree_map(put_rep, opt_state)
+        else:
+            # slice-shaped vector state lives over the data axis (ZeRO-1);
+            # scalar counters are replicated — same rule as _init_opt_state,
+            # applied to full-length (possibly checkpoint-resumed) leaves.
+            sliced = NamedSharding(self.mesh, P(DATA_AXIS))
+
+            def put_opt(x):
+                x = jnp.asarray(x)
+                if x.ndim >= 1 and x.shape[0] == full:
+                    return jax.device_put(x, sliced)
+                return put_rep(x)
+
+            opt_state = jax.tree_util.tree_map(put_opt, opt_state)
+        return params, buffers, opt_state
+
+    @staticmethod
+    def _fetch_host(x):
+        """Global array -> host value (multi-host safe): replicated arrays
+        read locally, axis-sharded ones gather via a process allgather."""
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            if not x.is_fully_replicated:
+                from jax.experimental import multihost_utils
+                return multihost_utils.process_allgather(x, tiled=True)
+        return np.asarray(x)
+
+    def _save_checkpoint(self, params, buffers, opt_state, driver_state):
+        if self.checkpoint_path is None:
+            return
+        if jax.process_count() > 1:
+            fetch = lambda t: jax.tree_util.tree_map(self._fetch_host, t)
+            # every process participates in the gather; only the 'driver'
+            # writes (reference: checkpoint written by the Spark driver)
+            params, buffers, opt_state = (fetch(params), fetch(buffers),
+                                          fetch(opt_state))
+            if jax.process_index() != 0:
+                return
+        super()._save_checkpoint(params, buffers, opt_state, driver_state)
+
+    def _run_validation(self, params, buffers, fwd):
+        """Multi-host: each process runs forward over ITS shard of the
+        validation set (the dataset must be distributed so records split by
+        process), then per-method (numerator, count) pairs merge via one
+        allgather — the TPU-native form of ``ValidationResult.+`` reduce
+        over executors (``optim/Evaluator.scala:48-73``)."""
+        if jax.process_count() <= 1:
+            return super()._run_validation(params, buffers, fwd)
+        from jax.experimental import multihost_utils
+        from bigdl_tpu.optim.evaluator import evaluate_batches
+
+        params_h = jax.tree_util.tree_map(
+            self._fetch_host, self._finalize_params(params))
+        buffers_h = jax.tree_util.tree_map(self._fetch_host, buffers)
+        if getattr(self, "_local_eval_fwd", None) is None:
+            model = self.model
+
+            def local_fwd(p, b, x):
+                out, _ = functional_apply(model, p, b, x, training=False)
+                return out
+
+            self._local_eval_fwd = jax.jit(local_fwd)
+        results, count = evaluate_batches(
+            self._local_eval_fwd, params_h, buffers_h,
+            self.validation_dataset.data(train=False),
+            self.validation_methods)
+        states = np.array(
+            [list(r.state()) if r is not None else [0.0, 0.0]
+             for r in results] + [[float(count), 0.0]], np.float64)
+        summed = multihost_utils.process_allgather(states).sum(axis=0)
+        # Rebuild results from the METHOD (identical on every host), not the
+        # local result object: a host whose shard was empty must still see
+        # the merged value, or driver_state['score'] diverges across hosts
+        # and score-triggered stops deadlock the pod.
+        merged = [
+            m.to_result(num, int(cnt)) if cnt > 0 else None
+            for m, (num, cnt) in zip(self.validation_methods, summed[:-1])]
+        return merged, int(summed[-1][0])
 
     # ------------------------------------------------------------------ step
     def _build_step(self) -> Callable:

@@ -27,6 +27,7 @@ import numpy as np
 class _EngineState:
     def __init__(self) -> None:
         self.initialized = False
+        self.dist_checked = False
         self.node_number = 1
         self.core_number = 1
         self._devices = None
@@ -51,6 +52,7 @@ class Engine:
         (``Engine.init`` at ``utils/Engine.scala:100``) but map to hosts and
         local chips. With no arguments, discovers the JAX runtime topology.
         """
+        Engine._maybe_init_distributed()
         import jax
 
         with _state._lock:
@@ -67,6 +69,72 @@ class Engine:
             native.set_num_threads(_state.core_number)
         except Exception:  # pragma: no cover - native layer is optional
             pass
+
+    @staticmethod
+    def _maybe_init_distributed() -> None:
+        """Multi-host bring-up: ``jax.distributed.initialize`` from env.
+
+        The reference parses its cluster topology out of spark-submit
+        properties (``utils/Engine.scala:346-416``); here the launcher
+        exports a coordinator endpoint instead:
+
+        - ``BIGDL_COORDINATOR_ADDRESS`` (or ``JAX_COORDINATOR_ADDRESS``) —
+          host:port of process 0's coordination service,
+        - ``BIGDL_NUM_PROCESSES`` / ``BIGDL_PROCESS_ID`` (or the JAX names).
+
+        On a real TPU pod slice none of these are needed (JAX auto-detects
+        via the TPU metadata server) — initialize is then a no-arg call,
+        triggered by ``BIGDL_AUTO_DISTRIBUTED=1``. Idempotent.
+        """
+        if _state.dist_checked:
+            return
+        coord = (os.environ.get("BIGDL_COORDINATOR_ADDRESS")
+                 or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+        auto = os.environ.get("BIGDL_AUTO_DISTRIBUTED", "0") == "1"
+        if not coord and not auto:
+            _state.dist_checked = True
+            return
+        import jax
+        if jax.distributed.is_initialized():
+            _state.dist_checked = True
+            return
+        # A genuine connect failure must RAISE: swallowing it would let N
+        # hosts silently train independently against one checkpoint path.
+        if coord:
+            nproc = (os.environ.get("BIGDL_NUM_PROCESSES")
+                     or os.environ.get("JAX_NUM_PROCESSES"))
+            pid = (os.environ.get("BIGDL_PROCESS_ID")
+                   or os.environ.get("JAX_PROCESS_ID"))
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(nproc) if nproc else None,
+                process_id=int(pid) if pid else None)
+        else:
+            jax.distributed.initialize()
+        _state.dist_checked = True
+        if jax.process_index() != 0:
+            # driver-style logging: per-iteration INFO only on process 0
+            # (reference logs on the Spark driver only)
+            import logging
+            logging.getLogger("bigdl_tpu.optim").setLevel(logging.WARNING)
+
+    @staticmethod
+    def process_index() -> int:
+        """This host's rank (0 = the 'driver' for logging/checkpoint IO)."""
+        Engine._maybe_init_distributed()  # before the backend freezes
+        import jax
+        return jax.process_index()
+
+    @staticmethod
+    def process_count() -> int:
+        Engine._maybe_init_distributed()
+        import jax
+        return jax.process_count()
+
+    @staticmethod
+    def local_devices():
+        import jax
+        return jax.local_devices()
 
     @staticmethod
     def is_initialized() -> bool:

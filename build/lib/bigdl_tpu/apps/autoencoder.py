@@ -1,0 +1,49 @@
+"""Autoencoder / MNIST train main (reference ``models/autoencoder/Train.scala``:
+MSE reconstruction, targets = inputs)."""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.apps.common import build_optimizer, train_parser
+from bigdl_tpu.dataset import mnist
+from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+from bigdl_tpu.models import autoencoder
+from bigdl_tpu.optim import Loss
+from bigdl_tpu.utils import file_io
+
+
+def _dataset(folder, batch, synthetic_size):
+    records = (mnist.load_dir(folder, train=True) if folder
+               else mnist.synthetic(synthetic_size))
+    def to_sample(recs):
+        for r in recs:
+            img = (np.frombuffer(r.data, np.uint8)[-784:]
+                   .reshape(784).astype(np.float32) / 255.0)
+            yield Sample(img, img)  # target == input
+    return DataSet.array(list(to_sample(records))).transform(
+        SampleToBatch(batch_size=batch))
+
+
+def train(argv) -> None:
+    args = train_parser("bigdl_tpu.apps.autoencoder train",
+                        default_batch=150, default_lr=0.01).parse_args(argv)
+    ds = _dataset(args.folder, args.batchSize, args.synthetic_size)
+    opt = build_optimizer(autoencoder.build(32), ds, nn.MSECriterion(), args,
+                          validation_set=ds, methods=[Loss(nn.MSECriterion())])
+    trained = opt.optimize()
+    if args.checkpoint:
+        file_io.save(trained, f"{args.checkpoint}/model_final")
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] != "train":
+        raise SystemExit("usage: python -m bigdl_tpu.apps.autoencoder train ...")
+    train(sys.argv[2:])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Inception-v1 ImageNet-shape train main + Caffe/Torch model-import path
+(reference ``models/inception/Train.scala:1-118`` and
+``example/loadmodel/ModelValidator.scala``)."""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.apps.common import build_optimizer, train_parser
+from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+from bigdl_tpu.models import inception
+from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy
+from bigdl_tpu.utils import file_io
+
+
+def _synthetic_imagenet(n: int, size: int = 224, classes: int = 1000):
+    rng = np.random.RandomState(11)
+    return [Sample(rng.randn(size, size, 3).astype(np.float32),
+                   np.float32(rng.randint(1, classes + 1))) for _ in range(n)]
+
+
+def _dataset(batch, synthetic_size):
+    return DataSet.array(_synthetic_imagenet(synthetic_size)).transform(
+        SampleToBatch(batch_size=batch))
+
+
+def train(argv) -> None:
+    parser = train_parser("bigdl_tpu.apps.inception train",
+                          default_batch=32, default_epochs=1, default_lr=0.01)
+    parser.add_argument("--caffeModel", default=None,
+                        help="init weights from a .caffemodel by layer name")
+    parser.add_argument("--torchModel", default=None,
+                        help="init the whole model from a .t7 file")
+    args = parser.parse_args(argv)
+    if args.torchModel:
+        from bigdl_tpu.interop import load_torch
+        model = load_torch(args.torchModel)
+    else:
+        model = inception.build(1000)
+        if args.caffeModel:
+            from bigdl_tpu.interop import load_caffe
+            model = load_caffe(model, args.caffeModel, match_all=False)
+    opt = build_optimizer(model, _dataset(args.batchSize, args.synthetic_size),
+                          nn.ClassNLLCriterion(), args,
+                          validation_set=_dataset(args.batchSize,
+                                                  args.synthetic_size),
+                          methods=[Top1Accuracy(), Top5Accuracy()])
+    trained = opt.optimize()
+    if args.checkpoint:
+        file_io.save(trained, f"{args.checkpoint}/model_final")
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] != "train":
+        raise SystemExit("usage: python -m bigdl_tpu.apps.inception train ...")
+    train(sys.argv[2:])
+
+
+if __name__ == "__main__":
+    main()

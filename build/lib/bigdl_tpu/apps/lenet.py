@@ -1,0 +1,55 @@
+"""LeNet-5 / MNIST train & test main (reference ``models/lenet/Train.scala:31``,
+``Test.scala``; CLI shape from ``models/lenet/Utils.scala``)."""
+
+from __future__ import annotations
+
+import sys
+
+from bigdl_tpu import nn
+from bigdl_tpu.apps.common import build_optimizer, run_test, test_parser, train_parser
+from bigdl_tpu.dataset import mnist
+from bigdl_tpu.dataset.base import DataSet
+from bigdl_tpu.dataset.image import (BytesToGreyImg, GreyImgNormalizer,
+                                     GreyImgToBatch)
+from bigdl_tpu.models import lenet
+from bigdl_tpu.optim import Top1Accuracy
+from bigdl_tpu.utils import file_io
+
+TRAIN_MEAN, TRAIN_STD = 0.13066047740239478 * 255, 0.3081078 * 255
+
+
+def _dataset(folder, batch, train, synthetic_size):
+    records = (mnist.load_dir(folder, train=train) if folder
+               else mnist.synthetic(synthetic_size))
+    return (DataSet.array(records) >> BytesToGreyImg(28, 28)
+            >> GreyImgNormalizer(TRAIN_MEAN, TRAIN_STD)
+            >> GreyImgToBatch(batch))
+
+
+def train(argv) -> None:
+    args = train_parser("bigdl_tpu.apps.lenet train",
+                        default_lr=0.05).parse_args(argv)
+    train_set = _dataset(args.folder, args.batchSize, True, args.synthetic_size)
+    val_set = _dataset(args.folder, args.batchSize, False, args.synthetic_size)
+    model = lenet.build(10)
+    opt = build_optimizer(model, train_set, nn.ClassNLLCriterion(), args,
+                          validation_set=val_set)
+    trained = opt.optimize()
+    if args.checkpoint:
+        file_io.save(trained, f"{args.checkpoint}/model_final")
+
+
+def test(argv) -> None:
+    args = test_parser("bigdl_tpu.apps.lenet test").parse_args(argv)
+    test_set = _dataset(args.folder, args.batchSize, False, args.synthetic_size)
+    run_test(args.model, test_set, [Top1Accuracy()])
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] not in ("train", "test"):
+        raise SystemExit("usage: python -m bigdl_tpu.apps.lenet {train|test} ...")
+    (train if sys.argv[1] == "train" else test)(sys.argv[2:])
+
+
+if __name__ == "__main__":
+    main()

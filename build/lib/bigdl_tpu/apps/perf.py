@@ -1,0 +1,123 @@
+"""Synthetic-data throughput harness (reference
+``models/utils/DistriOptimizerPerf.scala:32`` / ``LocalOptimizerPerf.scala``:
+inception/vgg mains with constant|random input, records/s per iteration).
+
+    python -m bigdl_tpu.apps.perf --model inception_v1 -b 32 -i 20
+    python -m bigdl_tpu.apps.perf --model resnet50 --distributed  # mesh DP
+
+``--distributed`` shards the batch over every visible device through
+DistriOptimizer (the reference's Perf main runs through DistriOptimizer the
+same way); default runs the single-chip LocalOptimizer path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _build_model(name: str):
+    from bigdl_tpu.models import inception, lenet, resnet, vgg
+    builders = {
+        "inception_v1": lambda: (inception.build(1000), (224, 224, 3)),
+        "vgg16": lambda: (vgg.build_imagenet(1000, depth=16), (224, 224, 3)),
+        "vgg19": lambda: (vgg.build_imagenet(1000, depth=19), (224, 224, 3)),
+        "resnet50": lambda: (resnet.build(1000, depth=50), (224, 224, 3)),
+        "lenet5": lambda: (lenet.build(10), (28, 28, 1)),
+    }
+    if name not in builders:
+        raise SystemExit(f"unknown model {name}; one of {sorted(builders)}")
+    return builders[name]()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="bigdl_tpu.apps.perf")
+    ap.add_argument("--model", "-m", default="inception_v1")
+    ap.add_argument("--batchSize", "-b", type=int, default=32)
+    ap.add_argument("--iteration", "-i", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--dataType", choices=("constant", "random"),
+                    default="random")
+    ap.add_argument("--precision", choices=("fp32", "bf16"), default="bf16")
+    ap.add_argument("--distributed", action="store_true",
+                    help="DistriOptimizer over all visible devices")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.ops.precision import DtypePolicy
+    from bigdl_tpu.utils.logger_filter import redirect_logs
+
+    redirect_logs()
+    model, shape = _build_model(args.model)
+    n_class = 1000 if args.model != "lenet5" else 10
+
+    rng = np.random.RandomState(0)
+    n_records = args.batchSize * 2  # endless shuffled iterator re-serves them
+    if args.dataType == "constant":
+        feats = [np.ones(shape, np.float32) for _ in range(n_records)]
+    else:
+        feats = [rng.randn(*shape).astype(np.float32)
+                 for _ in range(n_records)]
+    samples = [Sample(f, np.float32(rng.randint(1, n_class + 1)))
+               for f in feats]
+    ds = DataSet.array(samples).transform(
+        SampleToBatch(batch_size=args.batchSize))
+
+    if args.distributed:
+        from bigdl_tpu.parallel import MeshTopology
+        from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                              topology=MeshTopology.data_parallel())
+    else:
+        from bigdl_tpu.optim import Optimizer
+        opt = Optimizer(model, ds, nn.ClassNLLCriterion())
+    opt.set_optim_method(SGD(learningrate=0.01))
+    if args.precision == "bf16":
+        opt.set_precision(DtypePolicy.bf16())
+    total_iters = args.warmup + args.iteration
+
+    class _Recorder:
+        """Minimal TrainSummary-shaped sink capturing per-iteration
+        Throughput so the steady-state rate can exclude the first
+        ``warmup`` (compile-dominated) iterations."""
+        def __init__(self):
+            self.throughputs = []
+
+        def add_scalar(self, tag, value, step):
+            if tag == "Throughput":
+                self.throughputs.append(float(value))
+
+        def get_summary_trigger(self, name):
+            return None
+
+    recorder = _Recorder()
+    opt.set_train_summary(recorder)
+    opt.set_end_when(Trigger.max_iteration(total_iters))
+
+    t0 = time.time()
+    opt.optimize()
+    wall = time.time() - t0
+    steady = recorder.throughputs[args.warmup:]
+    print(json.dumps({
+        "harness": "perf", "model": args.model, "batch": args.batchSize,
+        "iterations": args.iteration, "wall_s": round(wall, 3),
+        "records_per_sec": round(float(np.mean(steady)), 1) if steady else 0.0,
+        "records_per_sec_incl_compile":
+            round(total_iters * args.batchSize / wall, 1),
+        "devices": len(jax.devices()),
+        "distributed": bool(args.distributed),
+        "precision": args.precision,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""ResNet train main (reference ``models/resnet/Train.scala`` — CIFAR-10
+ResNet-20/... with the Regime LR schedule; ``--depth 50 --imagenet`` selects
+the ImageNet-shape ResNet-50 used by the headline benchmark)."""
+
+from __future__ import annotations
+
+import sys
+
+from bigdl_tpu import nn
+from bigdl_tpu.apps.common import build_optimizer, run_test, test_parser, train_parser
+from bigdl_tpu.dataset import cifar
+from bigdl_tpu.dataset.base import DataSet
+from bigdl_tpu.dataset.image import (BGRImgNormalizer, BGRImgRdmCropper,
+                                     BGRImgToBatch, HFlip)
+from bigdl_tpu.models import resnet
+from bigdl_tpu.optim import SGD, Top1Accuracy
+from bigdl_tpu.optim.methods import EpochSchedule, Regime
+from bigdl_tpu.utils import file_io
+from bigdl_tpu.utils.table import T
+
+MEAN, STD = (125.3, 123.0, 113.9), (63.0, 62.1, 66.7)
+
+
+def _train_set(folder, batch, synthetic_size):
+    imgs = (cifar.load_dir(folder, train=True) if folder
+            else cifar.synthetic(synthetic_size))
+    return (DataSet.array(imgs) >> BGRImgNormalizer(MEAN, STD)
+            >> HFlip(0.5) >> BGRImgRdmCropper(32, 32, padding=4)
+            >> BGRImgToBatch(batch))
+
+
+def _val_set(folder, batch, synthetic_size):
+    imgs = (cifar.load_dir(folder, train=False) if folder
+            else cifar.synthetic(synthetic_size))
+    return (DataSet.array(imgs) >> BGRImgNormalizer(MEAN, STD)
+            >> BGRImgToBatch(batch))
+
+
+def train(argv) -> None:
+    import argparse
+    parser = train_parser("bigdl_tpu.apps.resnet train",
+                          default_epochs=165, default_lr=0.1)
+    parser.add_argument("--depth", type=int, default=20)
+    parser.add_argument("--shortcutType", default="A", choices=("A", "B"))
+    parser.add_argument("--nesterov", action=argparse.BooleanOptionalAction,
+                        default=True)
+    parser.set_defaults(weightDecay=1e-4)  # reference Train.scala default
+    args = parser.parse_args(argv)
+    model = resnet.build_cifar(10, depth=args.depth,
+                               shortcut_type=args.shortcutType)
+    opt = build_optimizer(
+        model, _train_set(args.folder, args.batchSize, args.synthetic_size),
+        nn.CrossEntropyCriterion(), args,
+        validation_set=_val_set(args.folder, args.batchSize,
+                                args.synthetic_size),
+        methods=[Top1Accuracy()])
+    # the reference's Regime schedule (models/resnet/Train.scala):
+    # epochs 1-80: lr, 81-120: lr/10, 121+: lr/100 — hyperparameters come
+    # from the CLI flags, only the schedule is fixed
+    opt.set_optim_method(SGD(
+        learningrate=args.learningRate, momentum=args.momentum,
+        dampening=0.0 if args.nesterov else args.momentum,
+        nesterov=args.nesterov, weightdecay=args.weightDecay,
+        learningrate_schedule=EpochSchedule([
+            Regime(1, 80, T(learningRate=args.learningRate)),
+            Regime(81, 120, T(learningRate=args.learningRate / 10)),
+            Regime(121, 100000, T(learningRate=args.learningRate / 100)),
+        ])))
+    trained = opt.optimize()
+    if args.checkpoint:
+        file_io.save(trained, f"{args.checkpoint}/model_final")
+
+
+def test(argv) -> None:
+    parser = test_parser("bigdl_tpu.apps.resnet test")
+    parser.add_argument("--depth", type=int, default=20)
+    args = parser.parse_args(argv)
+    run_test(args.model,
+             _val_set(args.folder, args.batchSize, args.synthetic_size),
+             [Top1Accuracy()])
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] not in ("train", "test"):
+        raise SystemExit("usage: python -m bigdl_tpu.apps.resnet {train|test} ...")
+    (train if sys.argv[1] == "train" else test)(sys.argv[2:])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""SimpleRNN text train main (reference ``models/rnn/Train.scala:1-135``:
+Dictionary build, sentence padding, TimeDistributedCriterion)."""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.apps.common import build_optimizer, train_parser
+from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+from bigdl_tpu.dataset.text import (Dictionary, LabeledSentenceToSample,
+                                    SentenceBiPadding, SentenceTokenizer,
+                                    TextToLabeledSentence)
+from bigdl_tpu.models import rnn
+from bigdl_tpu.optim import Loss
+from bigdl_tpu.utils import file_io
+
+_SYNTH_VOCAB = ["the", "quick", "brown", "fox", "jumps", "over", "lazy",
+                "dog", "a", "cat", "sat", "on", "mat"]
+
+
+def _synthetic_corpus(n: int, max_len: int = 12):
+    rng = np.random.RandomState(3)
+    return [" ".join(rng.choice(_SYNTH_VOCAB,
+                                size=rng.randint(4, max_len)).tolist())
+            for _ in range(n)]
+
+
+def _pipeline(sentences, batch, fixed_len):
+    tokens = list(SentenceTokenizer()(iter(sentences)))
+    tokens = list(SentenceBiPadding()(iter(tokens)))
+    dictionary = Dictionary(iter(tokens), vocab_size=4000)
+    vocab = dictionary.vocab_size() + 1
+    labeled = TextToLabeledSentence(dictionary)(iter(tokens))
+    samples = LabeledSentenceToSample(
+        vocab, fixed_length=fixed_len, one_hot=True)(labeled)
+    ds = DataSet.array(list(samples)).transform(
+        SampleToBatch(batch_size=batch))
+    return ds, vocab
+
+
+def train(argv) -> None:
+    parser = train_parser("bigdl_tpu.apps.rnn train",
+                          default_batch=12, default_epochs=2, default_lr=0.1)
+    parser.add_argument("--hiddenSize", type=int, default=40)
+    parser.add_argument("--sequenceLength", type=int, default=16)
+    args = parser.parse_args(argv)
+    if args.folder:
+        with open(args.folder) as f:
+            sentences = [line.strip() for line in f if line.strip()]
+    else:
+        sentences = _synthetic_corpus(args.synthetic_size // 8)
+    ds, vocab = _pipeline(sentences, args.batchSize, args.sequenceLength)
+    model = rnn.build(vocab, args.hiddenSize, vocab)
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                            size_average=True)
+    opt = build_optimizer(model, ds, criterion, args,
+                          validation_set=ds, methods=[Loss(criterion)])
+    trained = opt.optimize()
+    if args.checkpoint:
+        file_io.save(trained, f"{args.checkpoint}/model_final")
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] != "train":
+        raise SystemExit("usage: python -m bigdl_tpu.apps.rnn train ...")
+    train(sys.argv[2:])
+
+
+if __name__ == "__main__":
+    main()

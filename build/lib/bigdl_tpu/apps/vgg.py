@@ -1,0 +1,65 @@
+"""VGG / CIFAR-10 train & test main (reference ``models/vgg/Train.scala``,
+``Test.scala``)."""
+
+from __future__ import annotations
+
+import sys
+
+from bigdl_tpu import nn
+from bigdl_tpu.apps.common import build_optimizer, run_test, test_parser, train_parser
+from bigdl_tpu.dataset import cifar
+from bigdl_tpu.dataset.base import DataSet
+from bigdl_tpu.dataset.image import (BGRImgNormalizer, BGRImgRdmCropper,
+                                     BGRImgToBatch, HFlip)
+from bigdl_tpu.models import vgg
+from bigdl_tpu.optim import Top1Accuracy
+from bigdl_tpu.utils import file_io
+
+# CIFAR-10 channel stats (reference models/vgg/Train.scala)
+MEAN, STD = (125.3, 123.0, 113.9), (63.0, 62.1, 66.7)
+
+
+def _train_set(folder, batch, synthetic_size):
+    imgs = (cifar.load_dir(folder, train=True) if folder
+            else cifar.synthetic(synthetic_size))
+    return (DataSet.array(imgs)
+            >> BGRImgNormalizer(MEAN, STD)
+            >> HFlip(0.5)
+            >> BGRImgRdmCropper(32, 32, padding=4)
+            >> BGRImgToBatch(batch))
+
+
+def _val_set(folder, batch, synthetic_size):
+    imgs = (cifar.load_dir(folder, train=False) if folder
+            else cifar.synthetic(synthetic_size))
+    return (DataSet.array(imgs) >> BGRImgNormalizer(MEAN, STD)
+            >> BGRImgToBatch(batch))
+
+
+def train(argv) -> None:
+    args = train_parser("bigdl_tpu.apps.vgg train",
+                        default_lr=0.01).parse_args(argv)
+    opt = build_optimizer(
+        vgg.build(10), _train_set(args.folder, args.batchSize, args.synthetic_size),
+        nn.ClassNLLCriterion(), args,
+        validation_set=_val_set(args.folder, args.batchSize, args.synthetic_size))
+    trained = opt.optimize()
+    if args.checkpoint:
+        file_io.save(trained, f"{args.checkpoint}/model_final")
+
+
+def test(argv) -> None:
+    args = test_parser("bigdl_tpu.apps.vgg test").parse_args(argv)
+    run_test(args.model,
+             _val_set(args.folder, args.batchSize, args.synthetic_size),
+             [Top1Accuracy()])
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] not in ("train", "test"):
+        raise SystemExit("usage: python -m bigdl_tpu.apps.vgg {train|test} ...")
+    (train if sys.argv[1] == "train" else test)(sys.argv[2:])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+"""bigdl_tpu.dataset — data pipeline (reference ``$B/dataset/``)."""
+
+from bigdl_tpu.dataset.base import (
+    Sample, MiniBatch, ByteRecord, Transformer, ChainedTransformer,
+    Identity as IdentityTransformer, SampleToBatch,
+    AbstractDataSet, LocalDataSet, DistributedDataSet, DataSet,
+)
+from bigdl_tpu.dataset import image
+from bigdl_tpu.dataset import text
+from bigdl_tpu.dataset import mnist
+from bigdl_tpu.dataset import cifar

@@ -1,0 +1,56 @@
+"""CIFAR-10 binary reader (reference ``models/vgg/Utils.scala`` loads the
+binary batch format) plus synthetic generator for tests.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from bigdl_tpu.dataset.base import ByteRecord
+from bigdl_tpu.dataset.image import LabeledImage
+
+TRAIN_MEAN = (125.3, 123.0, 113.9)
+TRAIN_STD = (63.0, 62.1, 66.7)
+
+
+def load_bin(path: str) -> List[LabeledImage]:
+    """One CIFAR binary batch file: records of 1 label byte + 3072 CHW bytes.
+    Output is channels-last (32, 32, 3) float images, 1-based labels."""
+    out = []
+    with open(path, "rb") as f:
+        data = f.read()
+    rec = 3073
+    for i in range(len(data) // rec):
+        chunk = data[i * rec:(i + 1) * rec]
+        label = float(chunk[0]) + 1.0
+        img = np.frombuffer(chunk, np.uint8, count=3072, offset=1)
+        img = img.reshape(3, 32, 32).transpose(1, 2, 0).astype(np.float32)
+        out.append(LabeledImage(img, label))
+    return out
+
+
+def load_dir(folder: str, train: bool) -> List[LabeledImage]:
+    if train:
+        files = [os.path.join(folder, f"data_batch_{i}.bin") for i in range(1, 6)]
+    else:
+        files = [os.path.join(folder, "test_batch.bin")]
+    out: List[LabeledImage] = []
+    for f in files:
+        out.extend(load_bin(f))
+    return out
+
+
+def synthetic(n: int, seed: int = 7) -> List[LabeledImage]:
+    """Class-separable fake CIFAR for convergence tests."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        label = int(rng.integers(0, 10))
+        img = rng.normal(120.0, 20.0, (32, 32, 3)).astype(np.float32)
+        r, c = divmod(label, 4)
+        img[4 + r * 8:10 + r * 8, 4 + c * 7:10 + c * 7, label % 3] += 120.0
+        out.append(LabeledImage(img, float(label) + 1.0))
+    return out

@@ -1,0 +1,260 @@
+"""Image types and transformers (reference ``$B/dataset/image/``: 23 files).
+
+Images are numpy (H, W, C) float32 channels-last throughout — the TPU layout —
+labelled by a 1-based float class (Torch convention), mirroring the
+reference's ``LabeledBGRImage``/``LabeledGreyImage`` (``dataset/image/Types.scala``).
+Decode (JPEG etc.) is handled by ``LocalImgReader`` via Pillow when available;
+the tensor-side transformers below are pure numpy and are the ones on the
+training hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.base import ByteRecord, MiniBatch, Sample, Transformer
+from bigdl_tpu.utils.rng import RandomGenerator
+
+
+class LabeledImage:
+    """(H, W, C) float image + 1-based label (reference ``Types.scala``)."""
+
+    __slots__ = ("data", "label")
+
+    def __init__(self, data: np.ndarray, label: float):
+        self.data = np.asarray(data, np.float32)
+        self.label = float(label)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+
+LabeledGreyImage = LabeledImage
+LabeledBGRImage = LabeledImage
+
+
+class BytesToGreyImg(Transformer[ByteRecord, LabeledImage]):
+    """Decode row-major grey bytes (reference ``BytesToGreyImg``)."""
+
+    def __init__(self, row: int, col: int):
+        self.row, self.col = row, col
+
+    def __call__(self, prev: Iterator[ByteRecord]) -> Iterator[LabeledImage]:
+        for rec in prev:
+            img = np.frombuffer(rec.data, np.uint8).astype(np.float32)
+            yield LabeledImage(img.reshape(self.row, self.col, 1), rec.label)
+
+
+class BytesToBGRImg(Transformer[ByteRecord, LabeledImage]):
+    """Decode interleaved BGR bytes (reference ``BytesToBGRImg``)."""
+
+    def __init__(self, row: int, col: int):
+        self.row, self.col = row, col
+
+    def __call__(self, prev: Iterator[ByteRecord]) -> Iterator[LabeledImage]:
+        for rec in prev:
+            img = np.frombuffer(rec.data, np.uint8).astype(np.float32)
+            yield LabeledImage(img.reshape(self.row, self.col, 3), rec.label)
+
+
+class GreyImgNormalizer(Transformer[LabeledImage, LabeledImage]):
+    """(x - mean) / std with dataset-level stats
+    (reference ``GreyImgNormalizer``)."""
+
+    def __init__(self, mean: float, std: float):
+        self.mean, self.std = mean, std
+
+    @staticmethod
+    def from_dataset(dataset) -> "GreyImgNormalizer":
+        total, sq, n = 0.0, 0.0, 0
+        for img in dataset.data(train=False):
+            total += float(img.data.sum())
+            sq += float((img.data ** 2).sum())
+            n += img.data.size
+        mean = total / n
+        std = float(np.sqrt(sq / n - mean * mean))
+        return GreyImgNormalizer(mean, std)
+
+    def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        for img in prev:
+            yield LabeledImage((img.data - self.mean) / self.std, img.label)
+
+
+class BGRImgNormalizer(Transformer[LabeledImage, LabeledImage]):
+    """Per-channel normalization (reference ``BGRImgNormalizer``)."""
+
+    def __init__(self, mean: Tuple[float, float, float],
+                 std: Tuple[float, float, float]):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        for img in prev:
+            yield LabeledImage((img.data - self.mean) / self.std, img.label)
+
+
+class BGRImgCropper(Transformer[LabeledImage, LabeledImage]):
+    """Center/random crop (reference ``BGRImgCropper``)."""
+
+    def __init__(self, crop_width: int, crop_height: int, random: bool = True):
+        self.cw, self.ch, self.random = crop_width, crop_height, random
+
+    def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        rng = RandomGenerator.RNG()
+        for img in prev:
+            h, w = img.data.shape[:2]
+            if self.random:
+                y = int(rng.uniform(0, max(1, h - self.ch + 1)))
+                x = int(rng.uniform(0, max(1, w - self.cw + 1)))
+            else:
+                y, x = (h - self.ch) // 2, (w - self.cw) // 2
+            yield LabeledImage(img.data[y:y + self.ch, x:x + self.cw], img.label)
+
+
+class BGRImgRdmCropper(BGRImgCropper):
+    """Random crop with zero padding (reference ``BGRImgRdmCropper``)."""
+
+    def __init__(self, crop_width: int, crop_height: int, padding: int = 0):
+        super().__init__(crop_width, crop_height, random=True)
+        self.padding = padding
+
+    def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        def padded():
+            for img in prev:
+                if self.padding:
+                    d = np.pad(img.data, ((self.padding, self.padding),
+                                          (self.padding, self.padding), (0, 0)))
+                    yield LabeledImage(d, img.label)
+                else:
+                    yield img
+
+        return super().__call__(padded())
+
+
+class HFlip(Transformer[LabeledImage, LabeledImage]):
+    """Random horizontal flip (reference ``HFlip``)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+
+    def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        rng = RandomGenerator.RNG()
+        for img in prev:
+            if rng.uniform() < self.threshold:
+                yield LabeledImage(img.data[:, ::-1], img.label)
+            else:
+                yield img
+
+
+class ColorJitter(Transformer[LabeledImage, LabeledImage]):
+    """Random brightness/contrast/saturation (reference ``ColorJitter``)."""
+
+    def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
+                 saturation: float = 0.4):
+        self.brightness, self.contrast, self.saturation = brightness, contrast, saturation
+
+    def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        rng = RandomGenerator.RNG()
+        for img in prev:
+            d = img.data
+            order = [0, 1, 2]
+            rng.shuffle(order)
+            for op in order:
+                if op == 0 and self.brightness:
+                    alpha = 1.0 + float(rng.uniform(-self.brightness, self.brightness))
+                    d = d * alpha
+                elif op == 1 and self.contrast:
+                    alpha = 1.0 + float(rng.uniform(-self.contrast, self.contrast))
+                    grey_mean = d.mean()
+                    d = d * alpha + grey_mean * (1 - alpha)
+                elif op == 2 and self.saturation:
+                    alpha = 1.0 + float(rng.uniform(-self.saturation, self.saturation))
+                    grey = d.mean(axis=2, keepdims=True)
+                    d = d * alpha + grey * (1 - alpha)
+            yield LabeledImage(d, img.label)
+
+
+class Lighting(Transformer[LabeledImage, LabeledImage]):
+    """AlexNet PCA-noise lighting (reference ``Lighting``)."""
+
+    EIGVAL = np.asarray([0.2175, 0.0188, 0.0045], np.float32)
+    EIGVEC = np.asarray([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alphastd: float = 0.1):
+        self.alphastd = alphastd
+
+    def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        rng = RandomGenerator.RNG()
+        for img in prev:
+            alpha = rng.normal(0.0, self.alphastd, (3,)).astype(np.float32)
+            delta = (self.EIGVEC * alpha * self.EIGVAL).sum(axis=1)
+            yield LabeledImage(img.data + delta, img.label)
+
+
+class _ImgToBatch(Transformer[LabeledImage, MiniBatch]):
+    def __init__(self, batch_size: int, drop_remainder: bool = True):
+        self.batch_size = batch_size
+        self.drop_remainder = drop_remainder
+
+    def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[MiniBatch]:
+        feats, labels = [], []
+        for img in prev:
+            feats.append(img.data)
+            labels.append(img.label)
+            if len(feats) == self.batch_size:
+                yield MiniBatch(np.stack(feats), np.asarray(labels, np.float32))
+                feats, labels = [], []
+        if feats and not self.drop_remainder:
+            yield MiniBatch(np.stack(feats), np.asarray(labels, np.float32))
+
+
+class GreyImgToBatch(_ImgToBatch):
+    """reference ``GreyImgToBatch``."""
+
+
+class BGRImgToBatch(_ImgToBatch):
+    """reference ``BGRImgToBatch`` (also covering the multithreaded
+    ``MTLabeledBGRImgToBatch`` — host threading lives in Engine.io_pool-based
+    prefetch, not in the transformer)."""
+
+
+class GreyImgToSample(Transformer[LabeledImage, Sample]):
+    """reference ``GreyImgToSample``."""
+
+    def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[Sample]:
+        for img in prev:
+            yield Sample(img.data, np.float32(img.label))
+
+
+class BGRImgToSample(GreyImgToSample):
+    """reference ``BGRImgToSample``."""
+
+
+class LocalImgReader(Transformer[Tuple[str, float], LabeledImage]):
+    """Read + scale image files from disk (reference ``LocalImgReader``).
+    Items are (path, label). Requires Pillow; raises cleanly otherwise."""
+
+    def __init__(self, scale_to: int = 256):
+        self.scale_to = scale_to
+
+    def __call__(self, prev: Iterator[Tuple[str, float]]) -> Iterator[LabeledImage]:
+        try:
+            from PIL import Image as PILImage
+        except ImportError as e:  # pragma: no cover
+            raise RuntimeError("LocalImgReader requires Pillow") from e
+        for path, label in prev:
+            with PILImage.open(path) as im:
+                im = im.convert("RGB")
+                w, h = im.size
+                if min(w, h) != self.scale_to:
+                    if w < h:
+                        im = im.resize((self.scale_to, int(h * self.scale_to / w)))
+                    else:
+                        im = im.resize((int(w * self.scale_to / h), self.scale_to))
+                arr = np.asarray(im, np.float32)[:, :, ::-1]  # RGB->BGR like reference
+            yield LabeledImage(arr, label)

@@ -1,0 +1,64 @@
+"""MNIST idx-ubyte reader (reference ``models/lenet/Utils.scala`` load
+functions) plus a deterministic synthetic generator for tests/benchmarks
+(no-network environments).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import List, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.base import ByteRecord
+
+TRAIN_MEAN = 0.13066047740239506 * 255
+TRAIN_STD = 0.3081078 * 255
+TEST_MEAN = 0.13251460696903547 * 255
+TEST_STD = 0.31048024 * 255
+
+
+def _open(path: str):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+def load(features_file: str, labels_file: str) -> List[ByteRecord]:
+    """Parse idx3-ubyte images + idx1-ubyte labels into ByteRecords
+    (labels shifted to 1-based, reference ``Utils.load``)."""
+    with _open(labels_file) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad label magic {magic}"
+        labels = np.frombuffer(f.read(n), np.uint8)
+    with _open(features_file) as f:
+        magic, n2, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad image magic {magic}"
+        assert n2 == n
+        images = f.read(n * rows * cols)
+    rec_len = rows * cols
+    return [ByteRecord(images[i * rec_len:(i + 1) * rec_len], float(labels[i]) + 1.0)
+            for i in range(n)]
+
+
+def load_dir(folder: str, train: bool) -> List[ByteRecord]:
+    prefix = "train" if train else "t10k"
+    return load(os.path.join(folder, f"{prefix}-images-idx3-ubyte"),
+                os.path.join(folder, f"{prefix}-labels-idx1-ubyte"))
+
+
+def synthetic(n: int, seed: int = 42, separable: bool = True) -> List[ByteRecord]:
+    """Deterministic fake MNIST for tests: class-dependent blob positions so a
+    small model can actually learn (convergence tests need signal)."""
+    rng = np.random.default_rng(seed)
+    records = []
+    for i in range(n):
+        label = int(rng.integers(0, 10))
+        img = rng.integers(0, 30, (28, 28)).astype(np.uint8)
+        if separable:
+            # bright patch whose position encodes the class
+            r, c = divmod(label, 4)
+            y, x = 3 + r * 8, 3 + c * 6
+            img[y:y + 6, x:x + 6] = 220
+        records.append(ByteRecord(img.tobytes(), float(label) + 1.0))
+    return records

@@ -1,0 +1,158 @@
+"""Text pipeline (reference ``$B/dataset/text/``: ``Dictionary.scala:225``,
+``SentenceSplitter``/``SentenceTokenizer`` (OpenNLP-backed), ``SentenceBiPadding``,
+``TextToLabeledSentence``, ``LabeledSentenceToSample``).
+
+Tokenization here is regex-based (no OpenNLP on TPU hosts); everything else
+keeps the reference's semantics: sentence-boundary padding tokens, vocabulary
+with UNK, index (1-based) or one-hot sample encodings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from collections import Counter
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.base import Sample, Transformer
+
+SENTENCE_START = "SENTENCE_START"
+SENTENCE_END = "SENTENCE_END"
+_TOKEN_RE = re.compile(r"[A-Za-z0-9']+|[.,!?;]")
+
+
+class LabeledSentence:
+    """Token-index sequence + per-position (or scalar) labels
+    (reference ``text/LabeledSentence.scala``)."""
+
+    __slots__ = ("data", "label")
+
+    def __init__(self, data: Sequence[float], label: Sequence[float]):
+        self.data = np.asarray(data, np.float32)
+        self.label = np.asarray(label, np.float32)
+
+    def length(self) -> int:
+        return int(self.data.shape[0])
+
+
+class Dictionary:
+    """Vocabulary with save/load and UNK handling
+    (reference ``text/Dictionary.scala:225``)."""
+
+    def __init__(self, sentences: Optional[Iterator[List[str]]] = None,
+                 vocab_size: Optional[int] = None):
+        self._word2index = {}
+        self._index2word = {}
+        self._vocab_size = 0
+        if sentences is not None:
+            counts = Counter()
+            for tokens in sentences:
+                counts.update(tokens)
+            most = counts.most_common(vocab_size)
+            for i, (w, _) in enumerate(most):
+                self._word2index[w] = i
+                self._index2word[i] = w
+            self._vocab_size = len(self._word2index)
+
+    def get_index(self, word: str) -> int:
+        """0-based index; unknown words map to vocab_size (the UNK slot)."""
+        return self._word2index.get(word, self._vocab_size)
+
+    def get_word(self, index: int) -> str:
+        return self._index2word.get(int(index), "<unk>")
+
+    def vocab_size(self) -> int:
+        return self._vocab_size
+
+    def word2index(self):
+        return dict(self._word2index)
+
+    def save(self, folder: str) -> None:
+        os.makedirs(folder, exist_ok=True)
+        with open(os.path.join(folder, "dictionary.json"), "w") as f:
+            json.dump(self._word2index, f)
+
+    @staticmethod
+    def load(folder: str) -> "Dictionary":
+        d = Dictionary()
+        with open(os.path.join(folder, "dictionary.json")) as f:
+            d._word2index = json.load(f)
+        d._index2word = {v: k for k, v in d._word2index.items()}
+        d._vocab_size = len(d._word2index)
+        return d
+
+
+class SentenceSplitter(Transformer[str, List[str]]):
+    """Paragraph → sentences (reference ``SentenceSplitter``; regex here)."""
+
+    _SPLIT = re.compile(r"(?<=[.!?])\s+")
+
+    def __call__(self, prev: Iterator[str]) -> Iterator[List[str]]:
+        for para in prev:
+            yield [s for s in self._SPLIT.split(para.strip()) if s]
+
+
+class SentenceTokenizer(Transformer[str, List[str]]):
+    """Sentence → tokens (reference ``SentenceTokenizer``)."""
+
+    def __call__(self, prev: Iterator[str]) -> Iterator[List[str]]:
+        for sent in prev:
+            yield _TOKEN_RE.findall(sent.lower())
+
+
+class SentenceBiPadding(Transformer[List[str], List[str]]):
+    """Wrap with SENTENCE_START/END tokens (reference ``SentenceBiPadding``)."""
+
+    def __call__(self, prev: Iterator[List[str]]) -> Iterator[List[str]]:
+        for tokens in prev:
+            yield [SENTENCE_START] + list(tokens) + [SENTENCE_END]
+
+
+class TextToLabeledSentence(Transformer[List[str], LabeledSentence]):
+    """Language-model pairs: data = tokens[:-1], label = tokens[1:]
+    (reference ``TextToLabeledSentence``). Indices stay 0-based here;
+    ``LabeledSentenceToSample`` shifts to the framework's 1-based convention.
+    """
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def __call__(self, prev: Iterator[List[str]]) -> Iterator[LabeledSentence]:
+        for tokens in prev:
+            idx = [self.dictionary.get_index(t) for t in tokens]
+            if len(idx) < 2:
+                continue
+            yield LabeledSentence(idx[:-1], idx[1:])
+
+
+class LabeledSentenceToSample(Transformer[LabeledSentence, Sample]):
+    """Encode a LabeledSentence as a Sample
+    (reference ``LabeledSentenceToSample``): one-hot features (vocab+1 wide,
+    UNK included) or raw 1-based indices; labels always 1-based indices.
+    """
+
+    def __init__(self, vocab_length: int,
+                 fixed_length: Optional[int] = None,
+                 one_hot: bool = True):
+        self.vocab_length = vocab_length
+        self.fixed_length = fixed_length
+        self.one_hot = one_hot
+
+    def __call__(self, prev: Iterator[LabeledSentence]) -> Iterator[Sample]:
+        for s in prev:
+            n = s.length() if self.fixed_length is None else self.fixed_length
+            data_idx = s.data[:n].astype(np.int64)
+            label = s.label[:n].astype(np.float32) + 1.0
+            if len(data_idx) < n:
+                pad = n - len(data_idx)
+                data_idx = np.concatenate([data_idx, np.zeros(pad, np.int64)])
+                label = np.concatenate([label, np.ones(pad, np.float32)])
+            if self.one_hot:
+                feat = np.zeros((n, self.vocab_length), np.float32)
+                feat[np.arange(n), np.minimum(data_idx, self.vocab_length - 1)] = 1.0
+            else:
+                feat = (data_idx + 1).astype(np.float32)
+            yield Sample(feat, label)

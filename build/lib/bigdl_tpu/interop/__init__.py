@@ -1,0 +1,14 @@
+"""Model interop: Torch ``.t7`` and Caffe ``.caffemodel`` import/export
+(reference ``utils/TorchFile.scala:67`` and ``utils/CaffeLoader.scala:38``).
+
+Like the reference — which implements the full Torch binary type system in
+Scala and reads caffemodel protobufs through generated Java — this package
+carries no third-party dependency: ``torch_file`` speaks the ``.t7`` wire
+format directly and ``caffe`` walks the protobuf wire format by hand
+(field-number table instead of 96 kLoC of generated code).
+"""
+
+from bigdl_tpu.interop.torch_file import load_torch, save_torch
+from bigdl_tpu.interop.caffe import CaffeLoader, load_caffe
+
+__all__ = ["load_torch", "save_torch", "CaffeLoader", "load_caffe"]

@@ -1,0 +1,180 @@
+"""Caffe ``.caffemodel`` import (reference ``utils/CaffeLoader.scala:38``).
+
+The reference parses caffemodel protobufs through 96 kLoC of generated Java
+(``caffe/Caffe.java``) and copies weights **by layer name** into an existing
+model (``CaffeLoader.copyParameters``, ``CaffeLoader.scala:132``). Here the
+protobuf wire format is walked directly — the handful of field numbers needed
+(NetParameter → LayerParameter/V1LayerParameter → BlobProto) is a table, not
+a code generator.
+
+Field numbers (caffe.proto):
+
+    NetParameter:      name=1, layers(V1)=2, layer=100
+    LayerParameter:    name=1, type=2 (string), blobs=7
+    V1LayerParameter:  name=4, type=5 (enum), blobs=6
+    BlobProto:         num=1 channels=2 height=3 width=4 (legacy 4-D),
+                       data=5 (packed float), double_data=8, shape=7
+    BlobShape:         dim=1 (packed int64)
+
+Weight layouts: Caffe convolution blobs are (O, I/g, kH, kW) → converted to
+our HWIO; InnerProduct blobs are (out, in) → matches our Linear directly.
+"""
+
+from __future__ import annotations
+
+import logging
+import struct
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("bigdl_tpu.interop")
+
+from bigdl_tpu.utils.protowire import (  # noqa: E402
+    WT_VARINT as _WT_VARINT, WT_I64 as _WT_I64, WT_LEN as _WT_LEN,
+    WT_I32 as _WT_I32, iter_fields as _iter_fields,
+    read_varint as _read_varint)
+
+# V1LayerParameter.LayerType enum values used for weight-carrying layers
+_V1_TYPES = {4: "Convolution", 14: "InnerProduct", 39: "Deconvolution",
+             0: "None", 5: "Data", 18: "Pooling", 19: "Power", 33: "Scale"}
+
+
+def _parse_blob(buf: memoryview) -> np.ndarray:
+    shape: List[int] = []
+    legacy = [0, 0, 0, 0]  # num, channels, height, width
+    pieces: List[np.ndarray] = []
+    for field, wt, val in _iter_fields(buf):
+        if field == 7 and wt == _WT_LEN:  # BlobShape
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1:
+                    if w2 == _WT_LEN:  # packed int64
+                        pos = 0
+                        while pos < len(v2):
+                            d, pos = _read_varint(v2, pos)
+                            shape.append(d)
+                    elif w2 == _WT_VARINT:
+                        shape.append(v2)
+        elif field in (1, 2, 3, 4) and wt == _WT_VARINT:
+            legacy[field - 1] = val
+        elif field == 5 and wt == _WT_LEN:  # packed float data — protobuf
+            # allows one packed field split across several LEN records;
+            # parsers must concatenate (done once, below)
+            pieces.append(np.frombuffer(bytes(val), dtype="<f4"))
+        elif field == 8 and wt == _WT_LEN:  # packed double data
+            pieces.append(np.frombuffer(bytes(val), dtype="<f8")
+                          .astype(np.float32))
+        elif field == 5 and wt == _WT_I32:  # unpacked float (rare)
+            pieces.append(np.frombuffer(bytes(val), dtype="<f4"))
+    if not pieces:
+        return np.zeros((0,), dtype=np.float32)
+    data = pieces[0] if len(pieces) == 1 else np.concatenate(pieces)
+    if not shape and any(legacy):
+        shape = [d for d in legacy]
+        # legacy blobs are padded with 1s in the leading dims; keep all 4
+        shape = [d if d else 1 for d in shape]
+    if shape and int(np.prod(shape)) == data.size:
+        data = data.reshape(shape)
+    return data.astype(np.float32)
+
+
+class CaffeLayer:
+    def __init__(self, name: str, type_: str, blobs: List[np.ndarray]):
+        self.name = name
+        self.type = type_
+        self.blobs = blobs
+
+    def __repr__(self):
+        return (f"CaffeLayer({self.name!r}, {self.type!r}, "
+                f"blobs={[b.shape for b in self.blobs]})")
+
+
+def parse_caffemodel(path: str) -> List[CaffeLayer]:
+    """Extract every weight-carrying layer from a binary ``.caffemodel``."""
+    with open(path, "rb") as f:
+        buf = memoryview(f.read())
+    layers: List[CaffeLayer] = []
+    for field, wt, val in _iter_fields(buf):
+        if wt != _WT_LEN or field not in (2, 100):
+            continue
+        name, type_, blobs = "", "", []
+        if field == 100:  # LayerParameter
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1 and w2 == _WT_LEN:
+                    name = bytes(v2).decode("utf-8", "replace")
+                elif f2 == 2 and w2 == _WT_LEN:
+                    type_ = bytes(v2).decode("utf-8", "replace")
+                elif f2 == 7 and w2 == _WT_LEN:
+                    blobs.append(_parse_blob(v2))
+        else:  # V1LayerParameter
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 4 and w2 == _WT_LEN:
+                    name = bytes(v2).decode("utf-8", "replace")
+                elif f2 == 5 and w2 == _WT_VARINT:
+                    type_ = _V1_TYPES.get(v2, str(v2))
+                elif f2 == 6 and w2 == _WT_LEN:
+                    blobs.append(_parse_blob(v2))
+        if name:
+            layers.append(CaffeLayer(name, type_, blobs))
+    return layers
+
+
+class CaffeLoader:
+    """Copy caffemodel weights by layer name into an existing model
+    (reference ``CaffeLoader.copyParameters``)."""
+
+    def __init__(self, model, model_path: str, match_all: bool = True):
+        self.model = model
+        self.model_path = model_path
+        self.match_all = match_all
+
+    def _copy_conv(self, module, layer: CaffeLayer) -> None:
+        w = layer.blobs[0]
+        if w.ndim != 4:
+            w = w.reshape(module.n_output_plane, -1,
+                          module.kernel_h, module.kernel_w)
+        import jax.numpy as jnp
+        module.weight = jnp.asarray(np.transpose(w, (2, 3, 1, 0)))  # OIHW→HWIO
+        if len(layer.blobs) > 1 and getattr(module, "with_bias", True):
+            module.bias = jnp.asarray(layer.blobs[1].reshape(-1))
+
+    def _copy_linear(self, module, layer: CaffeLayer) -> None:
+        import jax.numpy as jnp
+        w = layer.blobs[0].reshape(module.output_size, module.input_size)
+        module.weight = jnp.asarray(w)  # caffe (out,in) == ours
+        if len(layer.blobs) > 1 and getattr(module, "with_bias", True):
+            module.bias = jnp.asarray(layer.blobs[1].reshape(-1))
+
+    def copy_parameters(self):
+        from bigdl_tpu import nn
+        layers = {l.name: l for l in parse_caffemodel(self.model_path)}
+        copied, missed = [], []
+        for name, module in self.model.named_modules():
+            lname = module.get_name()
+            layer = layers.get(lname)
+            if layer is None or not layer.blobs:
+                if isinstance(module, (nn.Linear, nn.SpatialConvolution)):
+                    missed.append(lname)
+                continue
+            if isinstance(module, nn.SpatialConvolution):
+                self._copy_conv(module, layer)
+            elif isinstance(module, nn.Linear):
+                self._copy_linear(module, layer)
+            else:
+                continue
+            copied.append(lname)
+        if missed and self.match_all:
+            raise ValueError(
+                f"caffemodel is missing weights for layers {missed}; "
+                f"pass match_all=False to load a partial match "
+                f"(reference CaffeLoader.scala:132 contract)")
+        for lname in missed:
+            logger.warning("no caffe weights for layer %s", lname)
+        logger.info("copied caffe weights for %d layers", len(copied))
+        return self.model
+
+
+def load_caffe(model, model_path: str, match_all: bool = True):
+    """Reference ``Module.loadCaffe(defPath, modelPath, matchAll)`` — the
+    prototxt is not needed for weight copy (names live in the caffemodel)."""
+    return CaffeLoader(model, model_path, match_all).copy_parameters()

@@ -1,0 +1,14 @@
+"""ML-pipeline integration (reference ``org/apache/spark/ml/DLClassifier.scala:35``
+and the ``MlTransformer`` version shims).
+
+The reference wraps a trained model as a Spark-ML ``Transformer`` that maps a
+features column to a prediction column over DataFrame rows. The TPU-native
+equivalent targets the Python data ecosystem instead of Spark: estimator/
+transformer classes with the scikit-learn protocol (``fit`` / ``predict`` /
+``predict_proba`` / ``transform``) over numpy arrays — batched, jitted
+forward passes underneath, no row-at-a-time Python.
+"""
+
+from bigdl_tpu.ml.classifier import DLClassifier, DLEstimator, DLModel
+
+__all__ = ["DLClassifier", "DLEstimator", "DLModel"]

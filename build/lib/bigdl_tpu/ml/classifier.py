@@ -1,0 +1,123 @@
+"""sklearn-protocol wrappers over bigdl_tpu modules
+(reference ``ml/DLClassifier.scala:35``: batch rows → ModelBroadcast forward →
+prediction column; here: numpy in, numpy out, jit underneath).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module, functional_apply
+
+
+class DLModel:
+    """A fitted transformer: batched jitted forward over numpy features
+    (reference ``DLClassifier.process`` batching loop — vectorized here)."""
+
+    def __init__(self, model: Module, batch_size: int = 128,
+                 feature_shape: Optional[Sequence[int]] = None,
+                 log_prob_head: bool = True):
+        self.model = model
+        self.batch_size = batch_size
+        self.feature_shape = tuple(feature_shape) if feature_shape else None
+        # the framework's classifier heads end in LogSoftMax; set False when
+        # wrapping a model whose head already emits probabilities
+        self.log_prob_head = log_prob_head
+        self._fwd = None
+
+    def _forward(self, params, buffers, x):
+        if self._fwd is None:
+            model = self.model
+
+            @jax.jit
+            def fwd(p, b, data):
+                out, _ = functional_apply(model, p, b, data, training=False)
+                return out
+
+            self._fwd = fwd
+        return self._fwd(params, buffers, x)
+
+    def transform(self, features: np.ndarray) -> np.ndarray:
+        """Forward every row; pads the tail batch to keep XLA shapes static
+        (the reference re-batches rows the same way)."""
+        x = np.asarray(features, dtype=np.float32)
+        if self.feature_shape is not None:
+            x = x.reshape((-1,) + self.feature_shape)
+        params = self.model.parameter_tree()
+        buffers = self.model.buffer_tree()
+        n = x.shape[0]
+        outs = []
+        bs = self.batch_size
+        for lo in range(0, n, bs):
+            chunk = x[lo:lo + bs]
+            pad = bs - chunk.shape[0]
+            if pad:  # static batch shape: pad and slice back
+                chunk = np.concatenate(
+                    [chunk, np.zeros((pad,) + chunk.shape[1:], chunk.dtype)])
+            out = np.asarray(self._forward(params, buffers, jnp.asarray(chunk)))
+            outs.append(out[:bs - pad] if pad else out)
+        return np.concatenate(outs) if outs else np.zeros((0,))
+
+    # sklearn aliases
+    def predict_proba(self, features: np.ndarray) -> np.ndarray:
+        """Probabilities. The representation is fixed by ``log_prob_head``
+        at construction — never inferred from the data, so the output scale
+        is stable across batches."""
+        out = self.transform(features)
+        return np.exp(out) if self.log_prob_head else out
+
+    def predict(self, features: np.ndarray) -> np.ndarray:
+        """1-based class ids, matching the framework's label convention."""
+        return np.argmax(self.transform(features), axis=-1) + 1
+
+
+class DLEstimator:
+    """Unfitted estimator: wraps (model, criterion, optim config); ``fit``
+    runs an Optimizer and returns a ``DLModel`` (reference ``DLEstimator``
+    in later BigDL; the v0.2 ``DLClassifier`` is transform-only)."""
+
+    def __init__(self, model: Module, criterion, batch_size: int = 128,
+                 max_epoch: int = 5, learning_rate: float = 0.01,
+                 feature_shape: Optional[Sequence[int]] = None,
+                 optim_method=None, log_prob_head: bool = True):
+        self.model = model
+        self.criterion = criterion
+        self.batch_size = batch_size
+        self.max_epoch = max_epoch
+        self.learning_rate = learning_rate
+        self.feature_shape = tuple(feature_shape) if feature_shape else None
+        self.optim_method = optim_method
+        self.log_prob_head = log_prob_head
+
+    def fit(self, features: np.ndarray, labels: np.ndarray) -> DLModel:
+        from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+        from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+        x = np.asarray(features, dtype=np.float32)
+        if self.feature_shape is not None:
+            x = x.reshape((-1,) + self.feature_shape)
+        y = np.asarray(labels, dtype=np.float32)
+        samples = [Sample(x[i], y[i]) for i in range(x.shape[0])]
+        ds = DataSet.array(samples).transform(
+            SampleToBatch(batch_size=self.batch_size))
+        opt = Optimizer(self.model, ds, self.criterion)
+        opt.set_optim_method(self.optim_method
+                             or SGD(learningrate=self.learning_rate))
+        opt.set_end_when(Trigger.max_epoch(self.max_epoch))
+        trained = opt.optimize()
+        return DLModel(trained, self.batch_size, self.feature_shape,
+                       log_prob_head=self.log_prob_head)
+
+
+class DLClassifier(DLEstimator):
+    """Classification estimator: NLL over LogSoftMax heads, 1-based labels
+    (the reference ``DLClassifier`` transforms only; fitting included here
+    for sklearn-protocol completeness)."""
+
+    def __init__(self, model: Module, criterion=None, **kwargs):
+        from bigdl_tpu.nn.criterion import ClassNLLCriterion
+        super().__init__(model, criterion or ClassNLLCriterion(), **kwargs)

@@ -1,0 +1,8 @@
+"""bigdl_tpu.models — reference workloads (reference ``$B/models/``)."""
+
+from bigdl_tpu.models import lenet
+from bigdl_tpu.models import vgg
+from bigdl_tpu.models import resnet
+from bigdl_tpu.models import inception
+from bigdl_tpu.models import autoencoder
+from bigdl_tpu.models import rnn

@@ -1,0 +1,16 @@
+"""MNIST autoencoder (reference ``models/autoencoder/Autoencoder.scala``):
+784 → 32 → 784 MLP trained with MSE."""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def build(class_num: int = 32) -> nn.Sequential:
+    """``class_num`` is the bottleneck width, matching the reference's arg."""
+    return (nn.Sequential()
+            .add(nn.Reshape((784,), batch_mode=True))
+            .add(nn.Linear(784, class_num))
+            .add(nn.ReLU(True))
+            .add(nn.Linear(class_num, 784))
+            .add(nn.Sigmoid()))

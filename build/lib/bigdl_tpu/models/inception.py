@@ -1,0 +1,65 @@
+"""Inception v1 / GoogLeNet (reference ``models/inception/Inception_v1.scala``)
+built as Concat-of-Sequential branches like the reference; channels-last.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def _conv(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph,
+                                       init_method="xavier").set_name(name + "conv"))
+            .add(nn.ReLU(True)))
+
+
+def inception_module(n_in, c1x1, c3x3r, c3x3, c5x5r, c5x5, pool_proj,
+                     name="inception"):
+    """One inception block: 4 parallel branches concatenated on channels
+    (reference ``Inception_v1.scala`` inception() builder — Concat on dim 1
+    of NCHW, i.e. the channel axis)."""
+    concat = nn.Concat(1).set_name(name)
+    concat.add(_conv(n_in, c1x1, 1, 1, name=f"{name}/1x1/"))
+    concat.add(nn.Sequential()
+               .add(_conv(n_in, c3x3r, 1, 1, name=f"{name}/3x3r/"))
+               .add(_conv(c3x3r, c3x3, 3, 3, 1, 1, 1, 1, name=f"{name}/3x3/")))
+    concat.add(nn.Sequential()
+               .add(_conv(n_in, c5x5r, 1, 1, name=f"{name}/5x5r/"))
+               .add(_conv(c5x5r, c5x5, 5, 5, 1, 1, 2, 2, name=f"{name}/5x5/")))
+    concat.add(nn.Sequential()
+               .add(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1))
+               .add(_conv(n_in, pool_proj, 1, 1, name=f"{name}/pool_proj/")))
+    return concat
+
+
+def build(class_num: int = 1000) -> nn.Sequential:
+    """Inception v1 main tower (no aux classifiers, like the reference's
+    ``Inception_v1_NoAuxClassifier``); input (N, 224, 224, 3)."""
+    model = (nn.Sequential()
+             .add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
+                                        init_method="xavier").set_name("conv1/7x7_s2"))
+             .add(nn.ReLU(True))
+             .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+             .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+             .add(_conv(64, 64, 1, 1, name="conv2/3x3_reduce/"))
+             .add(_conv(64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3/"))
+             .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+             .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+             .add(inception_module(192, 64, 96, 128, 16, 32, 32, "inception_3a"))
+             .add(inception_module(256, 128, 128, 192, 32, 96, 64, "inception_3b"))
+             .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+             .add(inception_module(480, 192, 96, 208, 16, 48, 64, "inception_4a"))
+             .add(inception_module(512, 160, 112, 224, 24, 64, 64, "inception_4b"))
+             .add(inception_module(512, 128, 128, 256, 24, 64, 64, "inception_4c"))
+             .add(inception_module(512, 112, 144, 288, 32, 64, 64, "inception_4d"))
+             .add(inception_module(528, 256, 160, 320, 32, 128, 128, "inception_4e"))
+             .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+             .add(inception_module(832, 256, 160, 320, 32, 128, 128, "inception_5a"))
+             .add(inception_module(832, 384, 192, 384, 48, 128, 128, "inception_5b"))
+             .add(nn.SpatialAveragePooling(7, 7, 1, 1))
+             .add(nn.Dropout(0.4))
+             .add(nn.Reshape((1024,), batch_mode=True))
+             .add(nn.Linear(1024, class_num).set_name("loss3/classifier"))
+             .add(nn.LogSoftMax()))
+    return model

@@ -1,0 +1,45 @@
+"""LeNet-5 on MNIST (reference ``models/lenet/LeNet5.scala:23`` +
+``Train.scala:31``): the canonical minimum end-to-end workload.
+
+Channels-last input (N, 28, 28, 1). Same topology as the reference:
+conv(1→6,5x5) → tanh → maxpool → conv(6→12,5x5) → tanh → maxpool →
+flatten → linear(12·4·4→100) → tanh → linear(100→classNum) → logsoftmax.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def build(class_num: int = 10) -> nn.Sequential:
+    return (nn.Sequential()
+            .add(nn.Reshape((28, 28, 1), batch_mode=True))
+            .add(nn.SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5"))
+            .add(nn.Tanh())
+            .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+            .add(nn.SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5"))
+            .add(nn.Tanh())
+            .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+            .add(nn.Reshape((12 * 4 * 4,), batch_mode=True))
+            .add(nn.Linear(12 * 4 * 4, 100).set_name("fc_1"))
+            .add(nn.Tanh())
+            .add(nn.Linear(100, class_num).set_name("fc_2"))
+            .add(nn.LogSoftMax()))
+
+
+def graph(class_num: int = 10) -> "nn.Graph":
+    """Same network as a Graph container (exercises the DAG path)."""
+    inp = nn.Input().inputs()
+    x = nn.Reshape((28, 28, 1), batch_mode=True).inputs(inp)
+    x = nn.SpatialConvolution(1, 6, 5, 5).inputs(x)
+    x = nn.Tanh().inputs(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2).inputs(x)
+    x = nn.SpatialConvolution(6, 12, 5, 5).inputs(x)
+    x = nn.Tanh().inputs(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2).inputs(x)
+    x = nn.Reshape((12 * 4 * 4,), batch_mode=True).inputs(x)
+    x = nn.Linear(12 * 4 * 4, 100).inputs(x)
+    x = nn.Tanh().inputs(x)
+    x = nn.Linear(100, class_num).inputs(x)
+    out = nn.LogSoftMax().inputs(x)
+    return nn.Graph(inp, out)

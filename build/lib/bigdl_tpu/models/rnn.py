@@ -1,0 +1,42 @@
+"""SimpleRNN language model (reference ``models/rnn/SimpleRNN.scala``):
+one-hot input → Recurrent(RnnCell) → per-step Linear+LogSoftMax, plus LSTM/GRU
+text-classifier variants (reference ``example/textclassification``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+
+
+def build(input_size: int, hidden_size: int, output_size: int) -> nn.Sequential:
+    """SimpleRNN: input (N, T, input_size) one-hot; output (N, T, output_size)
+    log-probs (train with TimeDistributedCriterion(ClassNLLCriterion))."""
+    return (nn.Sequential()
+            .add(nn.Recurrent().add(nn.RnnCell(input_size, hidden_size)))
+            .add(nn.TimeDistributed(
+                nn.Sequential()
+                .add(nn.Linear(hidden_size, output_size))
+                .add(nn.LogSoftMax()))))
+
+
+class _LastStep(nn.Module):
+    """Select the final timestep of (N, T, H)."""
+
+    def update_output(self, input):
+        return input[:, -1, :]
+
+
+def build_classifier(vocab_size: int, embed_dim: int, hidden_size: int,
+                     class_num: int, cell: str = "lstm") -> nn.Sequential:
+    """Text classifier: 1-based token indices (N, T) → LookupTable →
+    LSTM/GRU → last state → Linear → LogSoftMax (reference
+    ``example/textclassification`` GloVe+CNN analogue, recurrent flavor)."""
+    cells = {"lstm": nn.LSTM, "gru": nn.GRU, "rnn": nn.RnnCell}
+    return (nn.Sequential()
+            .add(nn.LookupTable(vocab_size, embed_dim))
+            .add(nn.Recurrent().add(cells[cell](embed_dim, hidden_size)))
+            .add(_LastStep())
+            .add(nn.Linear(hidden_size, class_num))
+            .add(nn.LogSoftMax()))

@@ -1,0 +1,140 @@
+"""Native C++ runtime components (reference §2.9: the BigDL-core MKL JNI
+library, ``com.intel.analytics.bigdl.mkl.MKL``).
+
+On TPU the math the reference routed to MKL (gemm/gemv/VML) lowers to the MXU
+via XLA/Pallas, so the native layer's job shifts to the *runtime around* the
+compute path — exactly the pieces the reference kept native or
+native-adjacent:
+
+- ``bt_crc32c``     — CRC32C for TFRecord framing (``java/netty/Crc32c.java``)
+- ``bt_fp32_to_bf16`` / ``bt_bf16_to_fp32`` / ``bt_bf16_add`` /
+  ``bt_bf16_accumulate`` — the bf16 compression codec
+  (``parameters/FP16CompressedTensor.scala``: fp32 truncated to its top
+  16 bits, multithreaded compress/decompress/add)
+- ``bt_kth_largest`` — quickselect (``utils/Util.scala:20``)
+- ``bt_set_num_threads`` — thread control (``MKL.setNumThreads``)
+
+Bound via ctypes (no pybind11). The shared library is compiled lazily from
+``src/*.cc`` with g++ on first import and cached next to the sources; if no
+toolchain is available, ``lib`` is None and every caller falls back to a pure
+Python/numpy path — the framework never hard-requires the native build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+logger = logging.getLogger("bigdl_tpu.native")
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_LIB_NAME = "libbigdl_tpu_native.so"
+_lock = threading.Lock()
+_build_attempted = False
+
+lib: Optional[ctypes.CDLL] = None
+
+
+def _candidate_paths():
+    yield os.path.join(os.path.dirname(__file__), _LIB_NAME)
+    cache = os.environ.get("BIGDL_TPU_NATIVE_CACHE",
+                           os.path.join(tempfile.gettempdir(),
+                                        "bigdl_tpu_native"))
+    yield os.path.join(cache, _LIB_NAME)
+
+
+def _sources():
+    return sorted(
+        os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
+        if f.endswith(".cc"))
+
+
+def _stale(path: str) -> bool:
+    try:
+        built = os.path.getmtime(path)
+    except OSError:
+        return True
+    return any(os.path.getmtime(s) > built for s in _sources())
+
+
+def _compile() -> Optional[str]:
+    cxx = os.environ.get("CXX", "g++")
+    for out_path in _candidate_paths():
+        out_dir = os.path.dirname(out_path)
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            cmd = [cxx, "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+                   "-o", out_path] + _sources()
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            return out_path
+        except (OSError, subprocess.SubprocessError) as e:
+            logger.debug("native build failed at %s: %s", out_path, e)
+    return None
+
+
+def _bind(path: str) -> ctypes.CDLL:
+    dll = ctypes.CDLL(path)
+    dll.bt_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    dll.bt_crc32c.restype = ctypes.c_uint32
+    fp = ctypes.POINTER(ctypes.c_float)
+    u16 = ctypes.POINTER(ctypes.c_uint16)
+    dll.bt_fp32_to_bf16.argtypes = [fp, u16, ctypes.c_size_t]
+    dll.bt_bf16_to_fp32.argtypes = [u16, fp, ctypes.c_size_t]
+    dll.bt_bf16_add.argtypes = [u16, u16, ctypes.c_size_t]
+    dll.bt_bf16_accumulate.argtypes = [fp, u16, ctypes.c_size_t]
+    dll.bt_set_num_threads.argtypes = [ctypes.c_int]
+    dll.bt_kth_largest.argtypes = [ctypes.POINTER(ctypes.c_double),
+                                   ctypes.c_size_t, ctypes.c_size_t]
+    dll.bt_kth_largest.restype = ctypes.c_double
+    return dll
+
+
+def load(force_rebuild: bool = False) -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global lib, _build_attempted
+    with _lock:
+        if lib is not None and not force_rebuild:
+            return lib
+        if _build_attempted and not force_rebuild:
+            return lib
+        _build_attempted = True
+        if os.environ.get("BIGDL_TPU_DISABLE_NATIVE"):
+            return None
+        path = None
+        if not force_rebuild:
+            for cand in _candidate_paths():
+                if os.path.exists(cand) and not _stale(cand):
+                    path = cand
+                    break
+        if path is None:
+            path = _compile()
+        if path is not None:
+            try:
+                lib = _bind(path)
+                logger.info("native library loaded from %s", path)
+            except OSError as e:  # pragma: no cover
+                logger.warning("native library load failed: %s", e)
+                lib = None
+        return lib
+
+
+def is_loaded() -> bool:
+    """Reference ``MKL.isMKLLoaded`` equivalent."""
+    return load() is not None
+
+
+def set_num_threads(n: int) -> None:
+    """Reference ``MKL.setNumThreads`` equivalent."""
+    dll = load()
+    if dll is not None:
+        dll.bt_set_num_threads(int(n))
+
+
+# NOTE: no eager load() here — the first actual native use (crc32c, codec,
+# Engine.init) triggers the build, keeping `import bigdl_tpu` free of
+# subprocess compiles.

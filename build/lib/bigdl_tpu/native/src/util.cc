@@ -1,0 +1,18 @@
+// Small native utilities: quickselect kth-largest (reference
+// utils/Util.scala:20, used for the straggler-drop threshold).
+#include <algorithm>
+#include <cstddef>
+#include <vector>
+
+extern "C" {
+
+// k is 1-based: k=1 returns the maximum (matching the reference's contract).
+double bt_kth_largest(const double* data, size_t n, size_t k) {
+  if (n == 0 || k == 0 || k > n) return 0.0;
+  std::vector<double> buf(data, data + n);
+  std::nth_element(buf.begin(), buf.begin() + (k - 1), buf.end(),
+                   std::greater<double>());
+  return buf[k - 1];
+}
+
+}  // extern "C"

@@ -1,0 +1,314 @@
+"""Activation layers (the ~25 activation files under reference ``$B/nn/``).
+
+All are pure elementwise jax.numpy expressions: XLA fuses them into the
+surrounding matmul/conv HLO, so — unlike the reference, where each activation
+is a separately-threaded strided loop (e.g. ``nn/Threshold.scala``) — none of
+these ever materialise a buffer on TPU.
+
+In-place flags from the reference (``ip``/``inplace``) are accepted for API
+compatibility but meaningless under XLA's functional arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule
+
+
+class ReLU(TensorModule):
+    """reference ``nn/ReLU.scala`` (Threshold at 0)."""
+
+    def __init__(self, ip: bool = False):
+        super().__init__()
+
+    def update_output(self, input):
+        return jax.nn.relu(input)
+
+
+class ReLU6(TensorModule):
+    """reference ``nn/ReLU6.scala``."""
+
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def update_output(self, input):
+        return jax.nn.relu6(input)
+
+
+class Threshold(TensorModule):
+    """x if x > th else v (reference ``nn/Threshold.scala:410``)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, ip: bool = False):
+        super().__init__()
+        self.th, self.v = th, v
+
+    def update_output(self, input):
+        return jnp.where(input > self.th, input, self.v)
+
+
+class PReLU(TensorModule):
+    """Learnable leaky slope (reference ``nn/PReLU.scala:316``).
+    ``n_output_plane=0`` → single shared parameter."""
+
+    def __init__(self, n_output_plane: int = 0):
+        super().__init__()
+        self.n_output_plane = n_output_plane
+        n = max(1, n_output_plane)
+        self.register_parameter("weight", jnp.full((n,), 0.25, jnp.float32))
+
+    def update_output(self, input):
+        w = self.weight
+        if self.n_output_plane > 0:
+            # Per-channel slope; channels-last layout.
+            w = jnp.reshape(w, (1,) * (input.ndim - 1) + (-1,))
+        return jnp.where(input >= 0, input, w * input)
+
+
+class RReLU(TensorModule):
+    """Randomized leaky ReLU (reference ``nn/RReLU.scala:176``)."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 inplace: bool = False):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def update_output(self, input):
+        if self.training:
+            a = jax.random.uniform(self.rng_key(), input.shape,
+                                   minval=self.lower, maxval=self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(input >= 0, input, a * input)
+
+
+class LeakyReLU(TensorModule):
+    """reference ``nn/LeakyReLU.scala``."""
+
+    def __init__(self, negval: float = 0.01, inplace: bool = False):
+        super().__init__()
+        self.negval = negval
+
+    def update_output(self, input):
+        return jnp.where(input >= 0, input, self.negval * input)
+
+
+class ELU(TensorModule):
+    """reference ``nn/ELU.scala``."""
+
+    def __init__(self, alpha: float = 1.0, inplace: bool = False):
+        super().__init__()
+        self.alpha = alpha
+
+    def update_output(self, input):
+        return jnp.where(input > 0, input, self.alpha * jnp.expm1(input))
+
+
+class Sigmoid(TensorModule):
+    """reference ``nn/Sigmoid.scala``."""
+
+    def update_output(self, input):
+        return jax.nn.sigmoid(input)
+
+
+class LogSigmoid(TensorModule):
+    """reference ``nn/LogSigmoid.scala``."""
+
+    def update_output(self, input):
+        return jax.nn.log_sigmoid(input)
+
+
+class Tanh(TensorModule):
+    """reference ``nn/Tanh.scala``."""
+
+    def update_output(self, input):
+        return jnp.tanh(input)
+
+
+class TanhShrink(TensorModule):
+    """x - tanh(x) (reference ``nn/TanhShrink.scala``)."""
+
+    def update_output(self, input):
+        return input - jnp.tanh(input)
+
+
+class HardTanh(TensorModule):
+    """reference ``nn/HardTanh.scala:195``."""
+
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 inplace: bool = False):
+        super().__init__()
+        self.min_value, self.max_value = min_value, max_value
+
+    def update_output(self, input):
+        return jnp.clip(input, self.min_value, self.max_value)
+
+
+class HardShrink(TensorModule):
+    """reference ``nn/HardShrink.scala``."""
+
+    def __init__(self, lambd: float = 0.5):
+        super().__init__()
+        self.lambd = lambd
+
+    def update_output(self, input):
+        return jnp.where(jnp.abs(input) > self.lambd, input, 0.0)
+
+
+class SoftShrink(TensorModule):
+    """reference ``nn/SoftShrink.scala``."""
+
+    def __init__(self, lambd: float = 0.5):
+        super().__init__()
+        self.lambd = lambd
+
+    def update_output(self, input):
+        return jnp.where(input > self.lambd, input - self.lambd,
+                         jnp.where(input < -self.lambd, input + self.lambd, 0.0))
+
+
+class SoftPlus(TensorModule):
+    """reference ``nn/SoftPlus.scala`` (with beta, linear above threshold)."""
+
+    def __init__(self, beta: float = 1.0):
+        super().__init__()
+        self.beta = beta
+        self.threshold = 20.0
+
+    def update_output(self, input):
+        bx = self.beta * input
+        return jnp.where(bx > self.threshold, input,
+                         jnp.log1p(jnp.exp(bx)) / self.beta)
+
+
+class SoftSign(TensorModule):
+    """x / (1 + |x|) (reference ``nn/SoftSign.scala``)."""
+
+    def update_output(self, input):
+        return input / (1.0 + jnp.abs(input))
+
+
+class SoftMax(TensorModule):
+    """reference ``nn/SoftMax.scala:198``: softmax over the feature dim
+    (last dim in channels-last layout)."""
+
+    def update_output(self, input):
+        return jax.nn.softmax(input, axis=-1)
+
+
+class SoftMin(TensorModule):
+    """reference ``nn/SoftMin.scala``."""
+
+    def update_output(self, input):
+        return jax.nn.softmax(-input, axis=-1)
+
+
+class LogSoftMax(TensorModule):
+    """reference ``nn/LogSoftMax.scala:164``."""
+
+    def update_output(self, input):
+        return jax.nn.log_softmax(input, axis=-1)
+
+
+class Clamp(HardTanh):
+    """reference ``nn/Clamp.scala``."""
+
+    def __init__(self, min_value: float, max_value: float):
+        super().__init__(float(min_value), float(max_value))
+
+
+class Power(TensorModule):
+    """(shift + scale·x)^power (reference ``nn/Power.scala``)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0):
+        super().__init__()
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def update_output(self, input):
+        return jnp.power(self.shift + self.scale * input, self.power)
+
+
+class Sqrt(TensorModule):
+    """reference ``nn/Sqrt.scala``."""
+
+    def update_output(self, input):
+        return jnp.sqrt(input)
+
+
+class Square(TensorModule):
+    """reference ``nn/Square.scala``."""
+
+    def update_output(self, input):
+        return input * input
+
+class Abs(TensorModule):
+    """reference ``nn/Abs.scala``."""
+
+    def update_output(self, input):
+        return jnp.abs(input)
+
+
+class Log(TensorModule):
+    """reference ``nn/Log.scala``."""
+
+    def update_output(self, input):
+        return jnp.log(input)
+
+
+class Exp(TensorModule):
+    """reference ``nn/Exp.scala``."""
+
+    def update_output(self, input):
+        return jnp.exp(input)
+
+
+class AddConstant(TensorModule):
+    """reference ``nn/AddConstant.scala``."""
+
+    def __init__(self, constant_scalar: float, inplace: bool = False):
+        super().__init__()
+        self.constant_scalar = constant_scalar
+
+    def update_output(self, input):
+        return input + self.constant_scalar
+
+
+class MulConstant(TensorModule):
+    """reference ``nn/MulConstant.scala``."""
+
+    def __init__(self, scalar: float, inplace: bool = False):
+        super().__init__()
+        self.scalar = scalar
+
+    def update_output(self, input):
+        return input * self.scalar
+
+
+class GradientReversal(TensorModule):
+    """Identity forward, -lambda·grad backward (reference
+    ``nn/GradientReversal.scala``) — expressed as a custom VJP."""
+
+    def __init__(self, the_lambda: float = 1.0):
+        super().__init__()
+        self.the_lambda = the_lambda
+
+        @jax.custom_vjp
+        def _rev(x):
+            return x
+
+        def _fwd(x):
+            return x, None
+
+        def _bwd(_, g):
+            return (-self.the_lambda * g,)
+
+        _rev.defvjp(_fwd, _bwd)
+        self._rev = _rev
+
+    def set_lambda(self, l: float) -> "GradientReversal":
+        self.the_lambda = l
+        return self
+
+    def update_output(self, input):
+        return self._rev(input)

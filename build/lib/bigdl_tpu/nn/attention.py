@@ -1,0 +1,278 @@
+"""Attention layers — new TPU-native capability.
+
+The reference has no attention (SURVEY §5.7 — sequence modelling stops at
+``nn/Recurrent.scala``/``nn/LSTM.scala``); long-context is first-class in the
+TPU build, so this module adds the transformer stack the reference lacks:
+``LayerNorm``, ``MultiHeadAttention``, ``TransformerEncoderLayer``, a
+sinusoidal ``PositionalEncoding``, and a stacked ``TransformerEncoder``.
+
+Compute-path notes (TPU-first):
+- projections are single MXU matmuls in the module's compute dtype;
+- the attention core lives in ``ops/attention_core.py`` (plain XLA or
+  flash-style blockwise ``lax.scan``) and in ``ops/flash_attention.py``
+  (Pallas kernel, used automatically on TPU for long sequences);
+- with a mesh ``seq`` axis, ``parallel/context.py`` runs the same layer
+  ring- or Ulysses-sharded — the module code does not change.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn import initialization as init
+from bigdl_tpu.nn.module import Module, TensorModule
+from bigdl_tpu.ops.precision import match_compute
+
+
+class LayerNorm(TensorModule):
+    """Per-feature layer normalisation over the last ``len(shape)`` axes.
+
+    Absent from the reference (which predates transformers; nearest is
+    ``nn/BatchNormalization.scala:50``) — required by the attention stack.
+    """
+
+    def __init__(self, normalized_shape, eps: float = 1e-5,
+                 elementwise_affine: bool = True):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.eps = eps
+        self.elementwise_affine = elementwise_affine
+        if elementwise_affine:
+            self.register_parameter("weight", init.ones(self.normalized_shape))
+            self.register_parameter("bias", init.zeros(self.normalized_shape))
+
+    def update_output(self, input):
+        axes = tuple(range(input.ndim - len(self.normalized_shape), input.ndim))
+        x = input.astype(jnp.float32)
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        y = y.astype(input.dtype)
+        if self.elementwise_affine:
+            y = y * self.weight + self.bias
+        return y
+
+    def __repr__(self):
+        return f"LayerNorm({self.normalized_shape})"
+
+
+class MultiHeadAttention(Module):
+    """Multi-head attention with fused qkv projection.
+
+    Input (B, S, E) [self-attention], Table {query, key, value}
+    [cross-attention], or Table {query, key, value, mask} — the 4th element
+    is a boolean mask broadcastable to (B, N, Sq, Sk), True = attend.
+
+    Per-batch masks MUST flow through the input (4-element Table): a mask set
+    via ``set_mask`` is module state, which a traced/jitted forward bakes in
+    as a compile-time constant — fine for a fixed structural mask, wrong for
+    masks that change per batch.
+
+    Weight layout matches Torch's ``nn.MultiheadAttention`` (in_proj stacked
+    q;k;v, each (E, E)) so oracle tests and weight import line up.
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int,
+                 dropout: float = 0.0, with_bias: bool = True,
+                 causal: bool = False, block_size: int = 0,
+                 seq_axis: Optional[str] = None, seq_mode: str = "ring"):
+        super().__init__()
+        assert embed_dim % num_heads == 0, "embed_dim must divide num_heads"
+        # seq_axis: mesh axis name for context parallelism. When set, the
+        # module must run inside shard_map with activations sharded
+        # (B, S/P, E) on that axis; attention goes through
+        # parallel/context.py (ring or ulysses).
+        self.seq_axis = seq_axis
+        self.seq_mode = seq_mode
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_p = dropout
+        self.with_bias = with_bias
+        self.causal = causal
+        from bigdl_tpu.nn.regularization import Dropout
+        self.dropout = Dropout(dropout)
+        # 0 = plain XLA attention; >0 = blockwise (flash) with that block.
+        self.block_size = block_size
+        self.register_parameter(
+            "in_proj_weight", init.xavier((3 * embed_dim, embed_dim),
+                                          embed_dim, embed_dim))
+        self.register_parameter(
+            "out_proj_weight", init.xavier((embed_dim, embed_dim),
+                                           embed_dim, embed_dim))
+        if with_bias:
+            self.register_parameter("in_proj_bias", init.zeros((3 * embed_dim,)))
+            self.register_parameter("out_proj_bias", init.zeros((embed_dim,)))
+        self.attn_mask: Optional[jax.Array] = None
+
+    def set_mask(self, mask: Optional[jax.Array]) -> "MultiHeadAttention":
+        """Static structural mask (baked in at trace time — see class doc;
+        per-batch masks go in the input Table instead)."""
+        self.attn_mask = mask
+        return self
+
+    def _split_heads(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.num_heads, self.head_dim)
+
+    def _project(self, x, w, b):
+        y = jnp.matmul(match_compute(x, w), w.T)
+        return y + b if b is not None else y
+
+    def update_output(self, input):
+        from bigdl_tpu.utils.table import Table
+        mask = self.attn_mask
+        if isinstance(input, Table):
+            query, key, value = input[1], input[2], input[3]
+            if len(input) >= 4:
+                mask = input[4]
+        elif isinstance(input, (tuple, list)):
+            query, key, value = input[:3]
+            if len(input) >= 4:
+                mask = input[3]
+        else:
+            query = key = value = input
+
+        e = self.embed_dim
+        wq, wk, wv = (self.in_proj_weight[:e], self.in_proj_weight[e:2 * e],
+                      self.in_proj_weight[2 * e:])
+        if self.with_bias:
+            bq, bk, bv = (self.in_proj_bias[:e], self.in_proj_bias[e:2 * e],
+                          self.in_proj_bias[2 * e:])
+        else:
+            bq = bk = bv = None
+        q = self._split_heads(self._project(query, wq, bq))
+        k = self._split_heads(self._project(key, wk, bk))
+        v = self._split_heads(self._project(value, wv, bv))
+
+        ctx = self._attend(q, k, v, mask)
+
+        b, s, _, _ = ctx.shape
+        ctx = ctx.reshape(b, s, e)
+        out = jnp.matmul(match_compute(ctx, self.out_proj_weight),
+                         self.out_proj_weight.T)
+        if self.with_bias:
+            out = out + self.out_proj_bias
+        return self.dropout.forward(out)
+
+    def _attend(self, q, k, v, mask):
+        from bigdl_tpu.ops import attention_core, flash_attention
+        if self.seq_axis is not None:
+            from bigdl_tpu.parallel import context
+            assert mask is None, (
+                "context-parallel attention supports causal masking only")
+            impl = (context.ring_attention if self.seq_mode == "ring"
+                    else context.ulysses_attention)
+            return impl(q, k, v, axis_name=self.seq_axis, causal=self.causal)
+        if flash_attention.use_flash(q, mask):
+            return flash_attention.flash_attention(q, k, v, causal=self.causal)
+        if self.block_size:
+            return attention_core.blockwise_attention(
+                q, k, v, mask=mask, causal=self.causal,
+                block_size=self.block_size)
+        return attention_core.dot_product_attention(
+            q, k, v, mask=mask, causal=self.causal)
+
+    def __repr__(self):
+        return (f"MultiHeadAttention({self.embed_dim}, heads={self.num_heads}"
+                f"{', causal' if self.causal else ''})")
+
+
+class PositionalEncoding(TensorModule):
+    """Sinusoidal position encoding added to (B, S, E) input."""
+
+    def __init__(self, embed_dim: int, max_len: int = 4096,
+                 dropout: float = 0.0):
+        super().__init__()
+        from bigdl_tpu.nn.regularization import Dropout
+        self.dropout = Dropout(dropout)
+        pos = np.arange(max_len)[:, None]
+        div = np.exp(np.arange(0, embed_dim, 2) * (-np.log(10000.0) / embed_dim))
+        pe = np.zeros((max_len, embed_dim), np.float32)
+        pe[:, 0::2] = np.sin(pos * div)
+        pe[:, 1::2] = np.cos(pos * div[: embed_dim // 2])
+        self.register_buffer("pe", pe)
+
+    def update_output(self, input):
+        s = input.shape[1]
+        return self.dropout.forward(input + self.pe[:s].astype(input.dtype))
+
+
+class TransformerEncoderLayer(Module):
+    """Pre-/post-norm transformer block: MHA + FFN with residuals."""
+
+    def __init__(self, embed_dim: int, num_heads: int, ffn_dim: int,
+                 dropout: float = 0.0, activation: str = "gelu",
+                 pre_norm: bool = True, causal: bool = False,
+                 block_size: int = 0, seq_axis: Optional[str] = None,
+                 seq_mode: str = "ring"):
+        super().__init__()
+        from bigdl_tpu.nn.linear import Linear
+        from bigdl_tpu.nn.regularization import Dropout
+        self.pre_norm = pre_norm
+        self.drop = Dropout(dropout)
+        self.activation = activation
+        self.self_attn = MultiHeadAttention(embed_dim, num_heads,
+                                            dropout=dropout, causal=causal,
+                                            block_size=block_size,
+                                            seq_axis=seq_axis,
+                                            seq_mode=seq_mode)
+        self.linear1 = Linear(embed_dim, ffn_dim)
+        self.linear2 = Linear(ffn_dim, embed_dim)
+        self.norm1 = LayerNorm(embed_dim)
+        self.norm2 = LayerNorm(embed_dim)
+
+    def _act(self, x):
+        if self.activation == "gelu":
+            return jax.nn.gelu(x)
+        if self.activation == "relu":
+            return jax.nn.relu(x)
+        raise ValueError(f"unknown activation {self.activation!r}")
+
+    def _drop(self, x):
+        return self.drop.forward(x)
+
+    def update_output(self, input):
+        x = input
+        if self.pre_norm:
+            x = x + self._drop(self.self_attn.forward(self.norm1.forward(x)))
+            h = self.linear2.forward(self._act(self.linear1.forward(
+                self.norm2.forward(x))))
+            return x + self._drop(h)
+        x = self.norm1.forward(x + self._drop(self.self_attn.forward(x)))
+        h = self.linear2.forward(self._act(self.linear1.forward(x)))
+        return self.norm2.forward(x + self._drop(h))
+
+
+class TransformerEncoder(Module):
+    """Stack of ``TransformerEncoderLayer`` with optional final norm."""
+
+    def __init__(self, num_layers: int, embed_dim: int, num_heads: int,
+                 ffn_dim: int, dropout: float = 0.0, activation: str = "gelu",
+                 pre_norm: bool = True, causal: bool = False,
+                 block_size: int = 0, seq_axis: Optional[str] = None,
+                 seq_mode: str = "ring"):
+        super().__init__()
+        self.num_layers = num_layers
+        for i in range(num_layers):
+            self.add_module(f"layer{i}", TransformerEncoderLayer(
+                embed_dim, num_heads, ffn_dim, dropout=dropout,
+                activation=activation, pre_norm=pre_norm, causal=causal,
+                block_size=block_size, seq_axis=seq_axis, seq_mode=seq_mode))
+        self.final_norm = LayerNorm(embed_dim) if pre_norm else None
+        if self.final_norm is not None:
+            self.add_module("final_norm", self.final_norm)
+
+    def update_output(self, input):
+        x = input
+        for i in range(self.num_layers):
+            x = self._modules[f"layer{i}"].forward(x)
+        if self.final_norm is not None:
+            x = self.final_norm.forward(x)
+        return x

@@ -1,0 +1,333 @@
+"""Containers and Table-structure ops (reference ``nn/Container.scala:40``,
+``Sequential.scala:30``, ``Concat.scala:42``, and the *Table layer family).
+
+The reference's ``Concat`` fans branches out onto a thread pool; here branches
+are just independent subgraphs in one traced program — XLA's scheduler
+overlaps them on the TPU's parallel units, no host threads involved.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Activity, Module
+from bigdl_tpu.utils.table import Table, T
+
+
+class Container(Module):
+    """Ordered-children container base (reference ``nn/Container.scala:40``)."""
+
+    def __init__(self):
+        super().__init__()
+        self._ordered: List[Module] = []
+
+    def add(self, module: Module) -> "Container":
+        self._ordered.append(module)
+        self.add_module(str(len(self._ordered) - 1), module)
+        return self
+
+    def __len__(self) -> int:
+        return len(self._ordered)
+
+    def __getitem__(self, i: int) -> Module:
+        return self._ordered[i]
+
+    def __repr__(self):
+        inner = "".join(f"\n  ({i}): " + repr(m).replace("\n", "\n  ")
+                        for i, m in enumerate(self._ordered))
+        return f"{type(self).__name__} {{{inner}\n}}"
+
+
+class Sequential(Container):
+    """Chain container (reference ``nn/Sequential.scala:30``)."""
+
+    def update_output(self, input):
+        out = input
+        for m in self._ordered:
+            out = m.forward(out)
+        return out
+
+
+class Concat(Container):
+    """Run branches on the same input, concat outputs on ``dimension``
+    (1-based, Torch convention; reference ``nn/Concat.scala:42``).
+
+    Dimension 1 is the first non-batch dim of a batched tensor — for a
+    channels-last 4-D activation the reference's "concat on dim 1 (channels)"
+    maps to the last axis; callers of this class give the reference's dim
+    counted in its NCHW world, so we translate: dim 1 → axis -1 for 4-D.
+    """
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def _axis(self, out):
+        # Translate the reference's 1-based non-batch NCHW dim to our
+        # channels-last axis: batched (N,H,W,C): C->3, H->1, W->2;
+        # unbatched (H,W,C): C->2, H->0, W->1; (N,F): dim 1 -> axis 1.
+        d = self.dimension
+        if out.ndim == 4:
+            return {1: 3, 2: 1, 3: 2}[d]
+        if out.ndim == 3:
+            return {1: 2, 2: 0, 3: 1}[d]
+        return d
+
+    def update_output(self, input):
+        outs = [m.forward(input) for m in self._ordered]
+        return jnp.concatenate(outs, axis=self._axis(outs[0]))
+
+
+class ConcatTable(Container):
+    """Branches over the same input, outputs collected into a Table
+    (reference ``nn/ConcatTable.scala``)."""
+
+    def update_output(self, input):
+        return T(*[m.forward(input) for m in self._ordered])
+
+
+class ParallelTable(Container):
+    """i-th module applied to i-th Table element (reference ``nn/ParallelTable.scala``)."""
+
+    def update_output(self, input):
+        return T(*[m.forward(input[i + 1]) for i, m in enumerate(self._ordered)])
+
+
+class MapTable(Container):
+    """One module mapped over every Table element (reference ``nn/MapTable.scala``).
+    All elements share the same parameters (the reference clones-with-shared
+    storage; functionally identical here)."""
+
+    def __init__(self, module: Optional[Module] = None):
+        super().__init__()
+        if module is not None:
+            self.add(module)
+
+    def update_output(self, input):
+        m = self._ordered[0]
+        return T(*[m.forward(input[i]) for i in range(1, input.length() + 1)])
+
+
+class JoinTable(Module):
+    """Concatenate Table elements along a dim (reference ``nn/JoinTable.scala``).
+
+    ``dimension`` is 1-based over the non-batch dims; ``n_input_dims`` tells
+    whether input includes a batch dim (reference semantics).
+    """
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def update_output(self, input):
+        elems = list(input) if isinstance(input, Table) else list(input)
+        axis = self.dimension - 1
+        if self.n_input_dims > 0 and elems[0].ndim == self.n_input_dims + 1:
+            axis += 1  # leading batch dim present
+        return jnp.concatenate(elems, axis=axis)
+
+
+class SplitTable(Module):
+    """Split a tensor into a Table along a dim (reference ``nn/SplitTable.scala``)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def update_output(self, input):
+        axis = self.dimension - 1
+        if self.n_input_dims > 0 and input.ndim == self.n_input_dims + 1:
+            axis += 1
+        if axis < 0:
+            axis += input.ndim
+        parts = [jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(input, input.shape[axis], axis=axis)]
+        return T(*parts)
+
+
+class SelectTable(Module):
+    """Pick the i-th Table element (1-based; reference ``nn/SelectTable.scala``)."""
+
+    def __init__(self, index: int):
+        super().__init__()
+        self.index = index
+
+    def update_output(self, input):
+        return input[self.index]
+
+
+class NarrowTable(Module):
+    """Slice a Table (reference ``nn/NarrowTable.scala``)."""
+
+    def __init__(self, offset: int, length: int = 1):
+        super().__init__()
+        self.offset, self.length = offset, length
+
+    def update_output(self, input):
+        n = self.length
+        if n < 0:
+            n = input.length() - self.offset + 1 + (self.length + 1)
+        return T(*[input[self.offset + i] for i in range(n)])
+
+
+class FlattenTable(Module):
+    """Flatten nested Tables (reference ``nn/FlattenTable.scala``)."""
+
+    def update_output(self, input):
+        flat = []
+
+        def walk(t):
+            for v in t:
+                if isinstance(v, Table):
+                    walk(v)
+                else:
+                    flat.append(v)
+
+        walk(input)
+        return T(*flat)
+
+
+class CAddTable(Module):
+    """Elementwise sum of Table elements (reference ``nn/CAddTable.scala``)."""
+
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def update_output(self, input):
+        out = input[1]
+        for i in range(2, input.length() + 1):
+            out = out + input[i]
+        return out
+
+
+class CSubTable(Module):
+    """input[1] - input[2] (reference ``nn/CSubTable.scala``)."""
+
+    def update_output(self, input):
+        return input[1] - input[2]
+
+
+class CMulTable(Module):
+    """Elementwise product (reference ``nn/CMulTable.scala``)."""
+
+    def update_output(self, input):
+        out = input[1]
+        for i in range(2, input.length() + 1):
+            out = out * input[i]
+        return out
+
+
+class CDivTable(Module):
+    """input[1] / input[2] (reference ``nn/CDivTable.scala``)."""
+
+    def update_output(self, input):
+        return input[1] / input[2]
+
+
+class CMaxTable(Module):
+    """Elementwise max (reference ``nn/CMaxTable.scala``)."""
+
+    def update_output(self, input):
+        out = input[1]
+        for i in range(2, input.length() + 1):
+            out = jnp.maximum(out, input[i])
+        return out
+
+
+class CMinTable(Module):
+    """Elementwise min (reference ``nn/CMinTable.scala``)."""
+
+    def update_output(self, input):
+        out = input[1]
+        for i in range(2, input.length() + 1):
+            out = jnp.minimum(out, input[i])
+        return out
+
+
+class MixtureTable(Module):
+    """Mixture-of-experts gate (reference ``nn/MixtureTable.scala:220``).
+
+    Input {gater (N, E), experts Table/tensor}; output Σ_e gate_e · expert_e.
+    This is the single-node MoE container; the *distributed* expert-parallel
+    version lives in ``bigdl_tpu.parallel`` (a new capability, absent in the
+    reference).
+    """
+
+    def __init__(self, dim: int = -1):
+        super().__init__()
+        self.dim = dim
+
+    def update_output(self, input):
+        gate, experts = input[1], input[2]
+        if isinstance(experts, Table):
+            expert_stack = jnp.stack(list(experts), axis=1)  # (N, E, ...)
+        else:
+            expert_stack = experts
+        g = gate.reshape(gate.shape + (1,) * (expert_stack.ndim - gate.ndim))
+        return jnp.sum(g * expert_stack, axis=1)
+
+
+class MaskedSelect(Module):
+    """Select by boolean mask (reference ``nn/MaskedSelect.scala``).
+
+    XLA note: returns the masked values compacted into a padded fixed-size
+    buffer under jit is impossible (dynamic shape); in eager mode returns the
+    compact vector like Torch. Inside jit, prefer ``jnp.where``.
+    """
+
+    def update_output(self, input):
+        x, mask = input[1], input[2]
+        return x[mask.astype(bool)]
+
+
+class Index(Module):
+    """index_select along a dim (reference ``nn/Index.scala``); indices 1-based."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def update_output(self, input):
+        x, idx = input[1], input[2]
+        return jnp.take(x, idx.astype(jnp.int32) - 1, axis=self.dimension - 1)
+
+
+class Bottle(Container):
+    """Flatten leading dims, apply inner module, restore
+    (reference ``nn/Bottle.scala``)."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2, n_output_dim: int = 2):
+        super().__init__()
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim
+        self.add(module)
+
+    def update_output(self, input):
+        if input.ndim <= self.n_input_dim:
+            return self._ordered[0].forward(input)
+        lead = input.shape[:input.ndim - self.n_input_dim + 1]
+        rest = input.shape[input.ndim - self.n_input_dim + 1:]
+        flat = jnp.reshape(input, (-1,) + rest)
+        out = self._ordered[0].forward(flat)
+        return jnp.reshape(out, lead + out.shape[1:])
+
+
+class Identity(Module):
+    """reference ``nn/Identity.scala``."""
+
+    def update_output(self, input):
+        return input
+
+
+class Echo(Module):
+    """Print shape while passing through (reference ``nn/Echo.scala``).
+    Under jit the print happens at trace time only."""
+
+    def update_output(self, input):
+        print(f"{self.name}: {getattr(input, 'shape', type(input))}")
+        return input

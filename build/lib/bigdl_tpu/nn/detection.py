@@ -1,0 +1,69 @@
+"""Detection utilities (reference ``nn/Nms.scala``, used with ``RoiPooling``).
+
+TPU-native NMS: the reference's greedy loop with data-dependent early exit
+becomes a fixed-trip ``lax.fori_loop`` over a masked score vector — static
+shapes, jit/vmap-able, padded output (the reference returns a variable-length
+index array; XLA cannot, so callers get (indices, count))."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+def _iou(boxes: jnp.ndarray, box: jnp.ndarray) -> jnp.ndarray:
+    """IoU of every row of ``boxes`` (N,4 xyxy) against one ``box`` (4,)."""
+    x1 = jnp.maximum(boxes[:, 0], box[0])
+    y1 = jnp.maximum(boxes[:, 1], box[1])
+    x2 = jnp.minimum(boxes[:, 2], box[2])
+    y2 = jnp.minimum(boxes[:, 3], box[3])
+    inter = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
+    area = ((boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]))
+    area_b = (box[2] - box[0]) * (box[3] - box[1])
+    return inter / jnp.maximum(area + area_b - inter, 1e-10)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def nms(boxes: jnp.ndarray, scores: jnp.ndarray, threshold: float,
+        max_output: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy NMS. Returns (indices, count): ``indices`` is (max_output,)
+    0-based into ``boxes`` padded with -1; ``count`` is the number kept."""
+    boxes = boxes.astype(jnp.float32)
+    live = scores.astype(jnp.float32)
+
+    def body(i, carry):
+        live, out, count = carry
+        best = jnp.argmax(live)
+        valid = live[best] > -jnp.inf
+        ious = _iou(boxes, boxes[best])
+        # suppress overlaps (incl. the selected box itself: iou==1)
+        suppress = (ious > threshold) | (jnp.arange(live.shape[0]) == best)
+        new_live = jnp.where(valid & suppress, -jnp.inf, live)
+        out = out.at[i].set(jnp.where(valid, best, -1))
+        return new_live, out, count + valid.astype(jnp.int32)
+
+    init = (jnp.where(jnp.isfinite(live), live, -jnp.inf),
+            jnp.full((max_output,), -1, jnp.int32),
+            jnp.asarray(0, jnp.int32))
+    _, out, count = jax.lax.fori_loop(0, max_output, body, init)
+    return out, count
+
+
+class Nms(Module):
+    """Module face of :func:`nms` (reference ``nn/Nms.scala``): input a table
+    ``(boxes, scores)``; output 1-based kept indices padded with 0."""
+
+    def __init__(self, threshold: float = 0.7, max_output: int = 100):
+        super().__init__()
+        self.threshold = threshold
+        self.max_output = max_output
+
+    def update_output(self, boxes, scores):
+        idx, _ = nms(jnp.asarray(boxes), jnp.asarray(scores),
+                     self.threshold, self.max_output)
+        return jnp.where(idx >= 0, idx + 1, 0)  # 1-based, 0-padded

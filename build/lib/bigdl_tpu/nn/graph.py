@@ -1,0 +1,131 @@
+"""Static DAG container (reference ``nn/Graph.scala:55`` over
+``utils/DirectedGraph.scala``).
+
+Build style mirrors the reference's ``.inputs(...)``:
+
+    inp = Input()
+    h = Linear(10, 20).inputs(inp)
+    h = ReLU().inputs(h)
+    out = Linear(20, 2).inputs(h)
+    model = Graph(inp, out)
+
+Execution: topological sort computed once at construction (Kahn, cycle check —
+reference ``Graph.scala:183-210``); ``forward`` walks the sorted list. Under
+``jit`` the walk is trace-time only — XLA sees one fused program, and
+multi-input fan-in/fan-out needs no gradient bookkeeping (autodiff handles
+the reference's ``Graph.scala:118-138`` accumulation).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Union
+
+from bigdl_tpu.nn.containers import Container
+from bigdl_tpu.nn.module import Activity, Module
+from bigdl_tpu.utils.table import Table, T
+
+
+class Node:
+    """Graph node wrapping a module (reference ``utils/Node``)."""
+
+    _counter = 0
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.prev: List["Node"] = []
+        Node._counter += 1
+        self.id = Node._counter
+
+    def __repr__(self):
+        return f"Node({self.module.name}#{self.id})"
+
+
+def _as_list(x) -> List:
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class Input(Module):
+    """Graph input placeholder (reference ``nn/Input.scala``)."""
+
+    def update_output(self, input):
+        return input
+
+    def inputs(self, *nodes) -> Node:
+        assert not nodes, "Input takes no predecessors"
+        return Node(self)
+
+
+def _inputs(self: Module, *nodes: Node) -> Node:
+    """``module.inputs(n1, n2, ...)`` → Node (reference ``AbstractModule.inputs``)."""
+    n = Node(self)
+    n.prev = list(nodes)
+    return n
+
+
+Module.inputs = _inputs  # graph-building verb available on every module
+
+
+class Graph(Container):
+    """DAG container (reference ``nn/Graph.scala:55``)."""
+
+    def __init__(self, input: Union[Node, Sequence[Node]],
+                 output: Union[Node, Sequence[Node]]):
+        super().__init__()
+        self.input_nodes = _as_list(input)
+        self.output_nodes = _as_list(output)
+        self._sorted = self._topo_sort()
+        # Register modules so parameter trees include them (stable names).
+        for i, node in enumerate(self._sorted):
+            self.add_module(f"n{i}_{node.module.name}", node.module)
+
+    def _topo_sort(self) -> List[Node]:
+        # Kahn's algorithm from the output side (reference builds the reverse
+        # graph from a dummy output, ``Graph.scala:183-210``).
+        nodes: List[Node] = []
+        seen: Dict[int, Node] = {}
+        stack = list(self.output_nodes)
+        while stack:
+            n = stack.pop()
+            if n.id in seen:
+                continue
+            seen[n.id] = n
+            nodes.append(n)
+            stack.extend(n.prev)
+        indegree = {n.id: len(n.prev) for n in nodes}
+        succ: Dict[int, List[Node]] = {n.id: [] for n in nodes}
+        for n in nodes:
+            for p in n.prev:
+                succ[p.id].append(n)
+        ready = [n for n in nodes if indegree[n.id] == 0]
+        order: List[Node] = []
+        while ready:
+            n = ready.pop()
+            order.append(n)
+            for s in succ[n.id]:
+                indegree[s.id] -= 1
+                if indegree[s.id] == 0:
+                    ready.append(s)
+        if len(order) != len(nodes):
+            raise ValueError("Graph contains a cycle")
+        for n in self.input_nodes:
+            if n.id not in seen:
+                raise ValueError("An input node is not connected to any output")
+        return order
+
+    def update_output(self, input):
+        values: Dict[int, Activity] = {}
+        ins = list(input) if isinstance(input, Table) else _as_list(input)
+        assert len(ins) == len(self.input_nodes), (
+            f"Graph expects {len(self.input_nodes)} inputs, got {len(ins)}")
+        for node, x in zip(self.input_nodes, ins):
+            values[node.id] = node.module.forward(x)
+        for node in self._sorted:
+            if node.id in values:
+                continue
+            args = [values[p.id] for p in node.prev]
+            x = args[0] if len(args) == 1 else T(*args)
+            values[node.id] = node.module.forward(x)
+        outs = [values[n.id] for n in self.output_nodes]
+        return outs[0] if len(outs) == 1 else T(*outs)

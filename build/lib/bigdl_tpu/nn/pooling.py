@@ -1,0 +1,185 @@
+"""Pooling layers (reference ``nn/SpatialMaxPooling.scala:43``,
+``nn/SpatialAveragePooling.scala``, ``nn/RoiPooling.scala:362``).
+
+The reference hand-rolls threaded pooling loops (``NNPrimitive.scala:356-498``)
+and stores argmax indices for backward; on TPU everything is
+``lax.reduce_window`` and autodiff recovers the argmax-routed gradient, so no
+index buffers exist. Layout is channels-last.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import TensorModule, Module
+
+
+def _pool_padding(in_size: int, k: int, stride: int, pad: int, ceil_mode: bool):
+    """(lo, hi) padding giving Torch floor/ceil output-size semantics."""
+    if ceil_mode:
+        out = int(np.ceil((in_size + 2 * pad - k) / stride)) + 1
+        # Torch: last window must start inside the (left-padded) input.
+        if pad > 0 and (out - 1) * stride >= in_size + pad:
+            out -= 1
+    else:
+        out = (in_size + 2 * pad - k) // stride + 1
+    needed = max(0, (out - 1) * stride + k - in_size - pad)
+    return pad, needed
+
+
+class SpatialMaxPooling(TensorModule):
+    """2-D max pooling (reference ``nn/SpatialMaxPooling.scala:43``)."""
+
+    def __init__(self, kw: int, kh: int, dw: int = None, dh: int = None,
+                 pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = False
+
+    def ceil(self) -> "SpatialMaxPooling":
+        self.ceil_mode = True
+        return self
+
+    def floor(self) -> "SpatialMaxPooling":
+        self.ceil_mode = False
+        return self
+
+    def update_output(self, input):
+        squeeze = input.ndim == 3
+        if squeeze:
+            input = input[None]
+        n, h, w, c = input.shape
+        ph = _pool_padding(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
+        pw = _pool_padding(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
+        out = jax.lax.reduce_window(
+            input, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, self.kh, self.kw, 1),
+            window_strides=(1, self.dh, self.dw, 1),
+            padding=((0, 0), ph, pw, (0, 0)))
+        return out[0] if squeeze else out
+
+    def __repr__(self):
+        return f"SpatialMaxPooling({self.kw}x{self.kh}, {self.dw},{self.dh})"
+
+
+class SpatialAveragePooling(TensorModule):
+    """2-D average pooling (reference ``nn/SpatialAveragePooling.scala:488``)."""
+
+    def __init__(self, kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 ceil_mode: bool = False,
+                 count_include_pad: bool = True,
+                 divide: bool = True):
+        super().__init__()
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+
+    def update_output(self, input):
+        squeeze = input.ndim == 3
+        if squeeze:
+            input = input[None]
+        n, h, w, c = input.shape
+        ph = _pool_padding(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
+        pw = _pool_padding(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
+        sums = jax.lax.reduce_window(
+            input, 0.0, jax.lax.add,
+            window_dimensions=(1, self.kh, self.kw, 1),
+            window_strides=(1, self.dh, self.dw, 1),
+            padding=((0, 0), ph, pw, (0, 0)))
+        if not self.divide:
+            return (sums[0] if squeeze else sums)
+        if self.count_include_pad:
+            out = sums / (self.kh * self.kw)
+        else:
+            counts = jax.lax.reduce_window(
+                jnp.ones((1, h, w, 1), input.dtype), 0.0, jax.lax.add,
+                window_dimensions=(1, self.kh, self.kw, 1),
+                window_strides=(1, self.dh, self.dw, 1),
+                padding=((0, 0), ph, pw, (0, 0)))
+            out = sums / counts
+        return out[0] if squeeze else out
+
+
+class VolumetricMaxPooling(TensorModule):
+    """3-D max pooling over NDHWC."""
+
+    def __init__(self, kt: int, kw: int, kh: int,
+                 dt: int = None, dw: int = None, dh: int = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.kt, self.kw, self.kh = kt, kw, kh
+        self.dt, self.dw, self.dh = dt or kt, dw or kw, dh or kh
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+
+    def update_output(self, input):
+        squeeze = input.ndim == 4
+        if squeeze:
+            input = input[None]
+        n, d, h, w, c = input.shape
+        pt = _pool_padding(d, self.kt, self.dt, self.pad_t, False)
+        ph = _pool_padding(h, self.kh, self.dh, self.pad_h, False)
+        pw = _pool_padding(w, self.kw, self.dw, self.pad_w, False)
+        out = jax.lax.reduce_window(
+            input, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, self.kt, self.kh, self.kw, 1),
+            window_strides=(1, self.dt, self.dh, self.dw, 1),
+            padding=((0, 0), pt, ph, pw, (0, 0)))
+        return out[0] if squeeze else out
+
+
+class RoiPooling(Module):
+    """Region-of-interest max pooling (reference ``nn/RoiPooling.scala:362``).
+
+    Input Table {data (N,H,W,C), rois (R,5) [batchIdx, x1, y1, x2, y2]};
+    output (R, pooledH, pooledW, C). Fixed output bins keep shapes static for
+    XLA; the bin reduction is a masked max — vectorised, not a Python loop.
+    """
+
+    def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float):
+        super().__init__()
+        self.pooled_w, self.pooled_h = pooled_w, pooled_h
+        self.spatial_scale = spatial_scale
+
+    def update_output(self, input):
+        data, rois = input[1], input[2]
+        n, h, w, c = data.shape
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+
+        def one_roi(roi):
+            batch_idx = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * self.spatial_scale)
+            y1 = jnp.round(roi[2] * self.spatial_scale)
+            x2 = jnp.round(roi[3] * self.spatial_scale)
+            y2 = jnp.round(roi[4] * self.spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            bin_w, bin_h = rw / self.pooled_w, rh / self.pooled_h
+            img = data[batch_idx]  # (H, W, C)
+
+            def one_bin(py, px):
+                hstart = jnp.floor(py * bin_h) + y1
+                hend = jnp.ceil((py + 1) * bin_h) + y1
+                wstart = jnp.floor(px * bin_w) + x1
+                wend = jnp.ceil((px + 1) * bin_w) + x1
+                ymask = (ys >= hstart) & (ys < hend) & (ys >= 0) & (ys < h)
+                xmask = (xs >= wstart) & (xs < wend) & (xs >= 0) & (xs < w)
+                mask = ymask[:, None] & xmask[None, :]
+                empty = ~jnp.any(mask)
+                vals = jnp.where(mask[:, :, None], img, -jnp.inf)
+                m = jnp.max(vals, axis=(0, 1))
+                return jnp.where(empty, 0.0, m)
+
+            py = jnp.arange(self.pooled_h)
+            px = jnp.arange(self.pooled_w)
+            return jax.vmap(lambda y: jax.vmap(lambda x: one_bin(y, x))(px))(py)
+
+        return jax.vmap(one_roi)(rois)

@@ -1,0 +1,105 @@
+"""Dropout and penalty layers (reference ``nn/Dropout.scala:43``,
+``nn/L1Penalty.scala``) plus the L1/L2 weight regularizers applied by
+OptimMethods (reference folds weight decay into SGD's update).
+
+Dropout draws its mask from the RngStream bound by ``functional_apply`` —
+deterministic per step key, SPMD-safe (each device sees the same key and the
+mask is sharded with the activation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule
+
+
+class Dropout(TensorModule):
+    """Inverted-scale dropout (reference ``nn/Dropout.scala:43``)."""
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False,
+                 scale: bool = True):
+        super().__init__()
+        self.p = init_p
+        self.scale = scale
+
+    def set_p(self, p: float) -> "Dropout":
+        self.p = p
+        return self
+
+    def update_output(self, input):
+        if not self.training or self.p <= 0.0:
+            return input
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(self.rng_key(), keep, input.shape)
+        out = jnp.where(mask, input, 0.0)
+        return out / keep if self.scale else out
+
+
+class L1Penalty(TensorModule):
+    """Identity forward that adds λ·|x| to the loss via gradient injection
+    (reference ``nn/L1Penalty.scala`` adds sign(x)·λ to gradInput)."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True):
+        super().__init__()
+        self.l1weight = l1weight
+        self.size_average = size_average
+
+        @jax.custom_vjp
+        def _pen(x):
+            return x
+
+        def _fwd(x):
+            return x, (x,)
+
+        def _bwd(res, g):
+            (x,) = res
+            w = self.l1weight / (x.size if self.size_average else 1)
+            return (g + w * jnp.sign(x),)
+
+        _pen.defvjp(_fwd, _bwd)
+        self._pen = _pen
+
+    def update_output(self, input):
+        return self._pen(input)
+
+
+class Regularizer:
+    """Weight-penalty spec attached to parameters (the reference's
+    ``wRegularizer``/``bRegularizer`` constructor args; applied by
+    OptimMethod as an added gradient term)."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1, self.l2 = l1, l2
+
+    def gradient(self, p: jax.Array) -> jax.Array:
+        g = jnp.zeros_like(p)
+        if self.l1:
+            g = g + self.l1 * jnp.sign(p)
+        if self.l2:
+            g = g + self.l2 * p
+        return g
+
+    def loss(self, p: jax.Array) -> jax.Array:
+        out = 0.0
+        if self.l1:
+            out = out + self.l1 * jnp.sum(jnp.abs(p))
+        if self.l2:
+            out = out + 0.5 * self.l2 * jnp.sum(p * p)
+        return out
+
+
+class L1Regularizer(Regularizer):
+    def __init__(self, l1: float):
+        super().__init__(l1=l1)
+
+
+class L2Regularizer(Regularizer):
+    def __init__(self, l2: float):
+        super().__init__(l2=l2)
+
+
+class L1L2Regularizer(Regularizer):
+    pass

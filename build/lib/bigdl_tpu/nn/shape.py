@@ -1,0 +1,247 @@
+"""Shape-manipulation layers (reference ``nn/Reshape.scala``, ``View``,
+``InferReshape.scala:156``, ``Squeeze``, ``Unsqueeze``, ``Transpose``,
+``Replicate``, ``Padding``, ``SpatialZeroPadding``, ``Narrow``, ``Select``,
+``Reverse``, ``Contiguous``).
+
+All are metadata ops under XLA (free or fused); ``Contiguous`` is a
+documented no-op because XLA arrays have no user-visible strides.
+Dims follow the Torch 1-based convention with an optional leading batch dim,
+matching the reference's ``batchMode`` handling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule
+
+
+class Reshape(TensorModule):
+    """reference ``nn/Reshape.scala``: reshape non-batch dims to ``size``.
+
+    ``batch_mode=None`` (default) infers: if the input's leading dim doesn't
+    match size[0] product decomposition, treat it as batch — same heuristic as
+    the reference (first-dim preserved when nelement differs).
+    """
+
+    def __init__(self, size: Sequence[int], batch_mode: Optional[bool] = None):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+        self._n = 1
+        for s in self.size:
+            self._n *= s
+
+    def update_output(self, input):
+        if self.batch_mode is True:
+            return jnp.reshape(input, (input.shape[0],) + self.size)
+        if self.batch_mode is False:
+            return jnp.reshape(input, self.size)
+        # infer
+        if input.size == self._n:
+            return jnp.reshape(input, self.size)
+        return jnp.reshape(input, (input.shape[0],) + self.size)
+
+    def __repr__(self):
+        return f"Reshape({'x'.join(map(str, self.size))})"
+
+
+class View(Reshape):
+    """reference ``nn/View.scala`` — same functional semantics as Reshape
+    here (XLA has no view/copy distinction)."""
+
+    def __init__(self, *sizes: int):
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        super().__init__(sizes, batch_mode=None)
+        self.num_input_dims = 0
+
+    def set_num_input_dims(self, n: int) -> "View":
+        self.num_input_dims = n
+        return self
+
+
+class InferReshape(TensorModule):
+    """Reshape with -1 (infer) and 0 (copy input dim) entries
+    (reference ``nn/InferReshape.scala:156``)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def update_output(self, input):
+        in_shape = input.shape[1:] if self.batch_mode else input.shape
+        out = []
+        for i, s in enumerate(self.size):
+            if s == 0:
+                out.append(in_shape[i])
+            else:
+                out.append(s)
+        if self.batch_mode:
+            return jnp.reshape(input, (input.shape[0],) + tuple(out))
+        return jnp.reshape(input, tuple(out))
+
+
+class Squeeze(TensorModule):
+    """reference ``nn/Squeeze.scala``; ``dim`` 1-based, 0 = all singleton dims."""
+
+    def __init__(self, dim: int = 0, num_input_dims: int = -1):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def update_output(self, input):
+        if self.dim == 0:
+            return jnp.squeeze(input)
+        axis = self.dim - 1
+        if self.num_input_dims > 0 and input.ndim == self.num_input_dims + 1:
+            axis += 1
+        return jnp.squeeze(input, axis=axis)
+
+
+class Unsqueeze(TensorModule):
+    """reference ``nn/Unsqueeze.scala``; insert singleton at 1-based ``pos``."""
+
+    def __init__(self, pos: int, num_input_dims: int = -1):
+        super().__init__()
+        self.pos = pos
+        self.num_input_dims = num_input_dims
+
+    def update_output(self, input):
+        axis = self.pos - 1
+        if self.num_input_dims > 0 and input.ndim == self.num_input_dims + 1:
+            axis += 1
+        return jnp.expand_dims(input, axis=axis)
+
+
+class Transpose(TensorModule):
+    """Sequence of pairwise dim swaps (1-based; reference ``nn/Transpose.scala``)."""
+
+    def __init__(self, permutations: Sequence[Sequence[int]]):
+        super().__init__()
+        self.permutations = [tuple(p) for p in permutations]
+
+    def update_output(self, input):
+        out = input
+        for d1, d2 in self.permutations:
+            out = jnp.swapaxes(out, d1 - 1, d2 - 1)
+        return out
+
+
+class Replicate(TensorModule):
+    """Repeat along a new dim (reference ``nn/Replicate.scala``)."""
+
+    def __init__(self, n_features: int, dim: int = 1, n_dim: int = -1):
+        super().__init__()
+        self.n_features, self.dim, self.n_dim = n_features, dim, n_dim
+
+    def update_output(self, input):
+        axis = self.dim - 1
+        if self.n_dim > 0 and input.ndim == self.n_dim + 1:
+            axis += 1
+        out = jnp.expand_dims(input, axis=axis)
+        reps = [1] * out.ndim
+        reps[axis] = self.n_features
+        return jnp.tile(out, reps)
+
+
+class Padding(TensorModule):
+    """Pad ``pad`` entries (negative = leading) on dim (reference ``nn/Padding.scala``)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int,
+                 value: float = 0.0, n_index: int = 1):
+        super().__init__()
+        self.dim, self.pad, self.n_input_dim = dim, pad, n_input_dim
+        self.value = value
+
+    def update_output(self, input):
+        axis = self.dim - 1
+        if input.ndim == self.n_input_dim + 1:
+            axis += 1
+        widths = [(0, 0)] * input.ndim
+        widths[axis] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(input, widths, constant_values=self.value)
+
+
+class SpatialZeroPadding(TensorModule):
+    """Zero-pad H/W of a channels-last image (reference ``nn/SpatialZeroPadding.scala``).
+    Negative padding crops."""
+
+    def __init__(self, pad_left: int, pad_right: int = None,
+                 pad_top: int = None, pad_bottom: int = None):
+        super().__init__()
+        self.pl = pad_left
+        self.pr = pad_right if pad_right is not None else pad_left
+        self.pt = pad_top if pad_top is not None else pad_left
+        self.pb = pad_bottom if pad_bottom is not None else pad_left
+
+    def update_output(self, input):
+        squeeze = input.ndim == 3
+        if squeeze:
+            input = input[None]
+        x = input
+        # crops first (negative pads)
+        h, w = x.shape[1], x.shape[2]
+        t, b = max(0, -self.pt), max(0, -self.pb)
+        l, r = max(0, -self.pl), max(0, -self.pr)
+        x = x[:, t:h - b, l:w - r, :]
+        x = jnp.pad(x, ((0, 0),
+                        (max(0, self.pt), max(0, self.pb)),
+                        (max(0, self.pl), max(0, self.pr)),
+                        (0, 0)))
+        return x[0] if squeeze else x
+
+
+class Narrow(TensorModule):
+    """Slice [offset, offset+length) on a dim (1-based; negative length counts
+    from the end; reference ``nn/Narrow.scala``)."""
+
+    def __init__(self, dimension: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dimension, self.offset, self.length = dimension, offset, length
+
+    def update_output(self, input):
+        axis = self.dimension - 1
+        start = self.offset - 1
+        length = self.length
+        if length < 0:
+            length = input.shape[axis] - start + length + 1
+        idx = [slice(None)] * input.ndim
+        idx[axis] = slice(start, start + length)
+        return input[tuple(idx)]
+
+
+class Select(TensorModule):
+    """Select one index on a dim, dropping it (1-based, negatives from end;
+    reference ``nn/Select.scala``)."""
+
+    def __init__(self, dimension: int, index: int):
+        super().__init__()
+        self.dimension, self.index = dimension, index
+
+    def update_output(self, input):
+        axis = self.dimension - 1 if self.dimension > 0 else input.ndim + self.dimension
+        idx = self.index - 1 if self.index > 0 else input.shape[axis] + self.index
+        return jnp.take(input, idx, axis=axis)
+
+
+class Reverse(TensorModule):
+    """Flip along a dim (reference ``nn/Reverse.scala``)."""
+
+    def __init__(self, dimension: int = 1):
+        super().__init__()
+        self.dimension = dimension
+
+    def update_output(self, input):
+        return jnp.flip(input, axis=self.dimension - 1)
+
+
+class Contiguous(TensorModule):
+    """No-op: XLA arrays are always logically contiguous
+    (reference ``nn/Contiguous.scala`` forces a copy for MKL)."""
+
+    def update_output(self, input):
+        return input

@@ -1,0 +1,3 @@
+"""bigdl_tpu.ops — numeric policies and custom kernels (Pallas)."""
+
+from bigdl_tpu.ops.precision import DtypePolicy, cast_tree, match_compute

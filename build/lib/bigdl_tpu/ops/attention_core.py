@@ -1,0 +1,217 @@
+"""Functional attention cores.
+
+New TPU-native capability — the reference has **no attention of any kind**
+(SURVEY §5.7: its longest-sequence machinery is the ``Recurrent`` time-loop,
+``nn/Recurrent.scala:66-135``). Attention is introduced here because
+long-context support is first-class in the TPU build: this module provides
+the single-device mathematical core; ``bigdl_tpu/parallel/context.py`` shards
+the same computation over a mesh ``seq`` axis (ring attention / Ulysses), and
+``bigdl_tpu/ops/flash_attention.py`` provides the Pallas TPU kernel.
+
+Two formulations of softmax(QK^T/sqrt(d))V are provided:
+
+- ``dot_product_attention`` — the plain XLA formulation. For moderate
+  sequence lengths XLA already fuses this well on TPU (two MXU matmuls with
+  a fused softmax between).
+- ``blockwise_attention`` — the online-softmax (flash) formulation over key
+  blocks via ``lax.scan``. O(S) memory in sequence length instead of O(S^2),
+  and the exact recurrence ring attention distributes over devices.
+
+Shapes follow the (batch, seq, heads, head_dim) = BSND convention; the head
+axis stays adjacent to head_dim so head-parallel (tensor) sharding splits a
+single array axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _mask_bias(mask: Optional[jax.Array], dtype) -> Optional[jax.Array]:
+    """Boolean mask (True = attend) -> additive bias."""
+    if mask is None:
+        return None
+    return jnp.where(mask, jnp.asarray(0.0, dtype),
+                     jnp.asarray(jnp.finfo(dtype).min, dtype))
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          mask: Optional[jax.Array] = None,
+                          bias: Optional[jax.Array] = None,
+                          causal: bool = False,
+                          scale: Optional[float] = None) -> jax.Array:
+    """softmax(q k^T * scale + bias) v, shapes (B, S, N, D).
+
+    ``mask``: broadcastable to (B, N, Sq, Sk), True where attention is
+    allowed. ``causal`` adds the lower-triangular mask.
+    """
+    *_, sq, n, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / float(d) ** 0.5
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k) * scale
+    logits = logits.astype(jnp.float32)  # softmax in f32: bf16 exp loses range
+    if bias is not None:
+        logits = logits + bias
+    mb = _mask_bias(mask, logits.dtype)
+    if mb is not None:
+        logits = logits + mb
+    if causal:
+        # Top-left alignment (query i sees keys <= i), matching the blockwise
+        # core, the Pallas kernel, and torch SDPA ``is_causal``.
+        cm = jnp.tril(jnp.ones((sq, sk), bool))
+        logits = jnp.where(cm, logits, jnp.finfo(logits.dtype).min)
+    # Fully-masked rows: softmax of all -inf would give a uniform average of
+    # values; zero them instead (batch-padding masks hit this).
+    dead = jnp.max(logits, axis=-1, keepdims=True) <= jnp.finfo(logits.dtype).min / 2
+    weights = jax.nn.softmax(logits, axis=-1)
+    weights = jnp.where(dead, 0.0, weights)
+    return jnp.einsum("bnqk,bknd->bqnd", weights.astype(q.dtype), v)
+
+
+def _block_scan(q, k, v, mask_bias, causal, scale, q_offset, block_size):
+    """Online-softmax scan over key blocks for one query block.
+
+    q: (B, Sq, N, D); k/v: (B, Sk, N, D); mask_bias broadcastable
+    (B, N, Sq, Sk) additive. Returns (B, Sq, N, D).
+
+    The recurrence carries (acc, row_sum, row_max) per query position —
+    identical to the flash-attention forward and to what each ring step
+    folds in (parallel/context.py reuses ``online_softmax_combine``).
+    """
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    nblocks = -(-sk // block_size)
+    pad = nblocks * block_size - sk
+    if pad:
+        kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    else:
+        kp, vp = k, v
+    kb = kp.reshape(b, nblocks, block_size, n, d).transpose(1, 0, 2, 3, 4)
+    vb = vp.reshape(b, nblocks, block_size, n, d).transpose(1, 0, 2, 3, 4)
+
+    neg = jnp.finfo(jnp.float32).min
+    q_pos = q_offset + jnp.arange(sq)
+
+    def step(carry, xs):
+        acc, rsum, rmax = carry
+        kblk, vblk, blk_idx = xs
+        k_pos = blk_idx * block_size + jnp.arange(block_size)
+        logits = jnp.einsum("bqnd,bknd->bnqk", q, kblk) * scale
+        logits = logits.astype(jnp.float32)
+        if mask_bias is not None:
+            start = blk_idx * block_size
+            mb = lax.dynamic_slice_in_dim(mask_bias, start, block_size, axis=3)
+            logits = logits + mb
+        valid = k_pos < sk
+        if causal:
+            valid = valid[None, :] & (k_pos[None, :] <= q_pos[:, None])
+            logits = jnp.where(valid[None, None], logits, neg)
+        else:
+            logits = jnp.where(valid[None, None, None, :], logits, neg)
+        blk_max = jnp.max(logits, axis=-1)                    # (B,N,Sq)
+        new_max = jnp.maximum(rmax, blk_max)
+        p = jnp.exp(logits - new_max[..., None])              # (B,N,Sq,K)
+        # Rows with every key masked so far: p would be e^0 = 1 everywhere
+        # (uniform garbage); keep them empty until a live key appears.
+        dead = new_max <= neg / 2
+        p = jnp.where(dead[..., None], 0.0, p)
+        correction = jnp.where(dead, 1.0, jnp.exp(rmax - new_max))
+        blk_sum = jnp.sum(p, axis=-1)
+        new_sum = rsum * correction + blk_sum
+        pv = jnp.einsum("bnqk,bknd->bqnd", p, vblk.astype(jnp.float32))
+        new_acc = acc * correction.transpose(0, 2, 1)[..., None] + pv
+        return (new_acc, new_sum, new_max), None
+
+    if pad and mask_bias is not None:
+        mask_bias = jnp.pad(mask_bias, ((0, 0),) * 3 + ((0, pad),),
+                            constant_values=neg)
+    # Derive the zero carries from q so they carry q's device-varying type
+    # when traced inside shard_map (vma typing rejects unvarying inits whose
+    # loop outputs vary over a mesh axis).
+    acc0 = jnp.zeros_like(q, dtype=jnp.float32)
+    zero_bnq = jnp.sum(q * 0.0, axis=-1, dtype=jnp.float32).transpose(0, 2, 1)
+    sum0 = zero_bnq
+    max0 = zero_bnq + neg
+    (acc, rsum, rmax), _ = lax.scan(
+        step, (acc0, sum0, max0),
+        (kb, vb, jnp.arange(nblocks)))
+    rsum = jnp.maximum(rsum, 1e-37)  # fully-masked rows -> 0 output, not NaN
+    out = acc / rsum.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        mask: Optional[jax.Array] = None,
+                        causal: bool = False,
+                        scale: Optional[float] = None,
+                        block_size: int = 512) -> jax.Array:
+    """Flash-style exact attention with O(S) memory (BSND shapes)."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / float(d) ** 0.5
+    mb = _mask_bias(mask, jnp.float32)
+    if mb is not None:
+        mb = jnp.broadcast_to(
+            mb, (q.shape[0], q.shape[2], q.shape[1], k.shape[1]))
+    return _block_scan(q, k, v, mb, causal, scale, 0,
+                       min(block_size, k.shape[1]))
+
+
+def online_softmax_combine(acc_a, sum_a, max_a, acc_b, sum_b, max_b):
+    """Merge two partial attention results over disjoint key sets.
+
+    Each partial is (acc = sum_j e^{l_j - max} v_j, row_sum, row_max) with
+    acc shaped (B, Sq, N, D) and sums/maxes (B, N, Sq). Associative and
+    commutative — ring attention folds per-device partials with this.
+    """
+    new_max = jnp.maximum(max_a, max_b)
+    ca = jnp.exp(max_a - new_max)
+    cb = jnp.exp(max_b - new_max)
+    new_sum = sum_a * ca + sum_b * cb
+    new_acc = (acc_a * ca.transpose(0, 2, 1)[..., None]
+               + acc_b * cb.transpose(0, 2, 1)[..., None])
+    return new_acc, new_sum, new_max
+
+
+def attention_partial(q, k, v, scale, k_offset, q_offset, causal,
+                      kv_valid_len=None):
+    """Unnormalised attention of q against one key/value chunk.
+
+    Returns (acc, row_sum, row_max) suitable for ``online_softmax_combine``.
+    ``k_offset``/``q_offset`` are the global positions of the chunks'
+    first elements (needed for causal masking across devices).
+    """
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    neg = jnp.finfo(jnp.float32).min
+    if kv_valid_len is not None:
+        valid = jnp.arange(sk) < kv_valid_len
+        logits = jnp.where(valid[None, None, None, :], logits, neg)
+    if causal:
+        q_pos = q_offset + jnp.arange(sq)
+        k_pos = k_offset + jnp.arange(sk)
+        cm = k_pos[None, :] <= q_pos[:, None]
+        logits = jnp.where(cm[None, None], logits, neg)
+    rmax = jnp.max(logits, axis=-1)                      # (B,N,Sq)
+    p = jnp.exp(logits - rmax[..., None])
+    # A fully-masked chunk has rmax == -inf -> p == e^0 == 1 rows; zero them.
+    dead = rmax <= neg / 2
+    p = jnp.where(dead[..., None], 0.0, p)
+    rmax = jnp.where(dead, neg, rmax)
+    rsum = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bnqk,bknd->bqnd", p, v.astype(jnp.float32))
+    return acc, rsum, rmax
+
+
+def finalize_partial(acc, rsum):
+    rsum = jnp.maximum(rsum, 1e-37)
+    return acc / rsum.transpose(0, 2, 1)[..., None]

@@ -1,0 +1,164 @@
+"""Flash attention as a Pallas TPU kernel.
+
+New capability (no reference analogue — the reference's hottest hand-written
+loops are im2col/col2im, ``nn/NNPrimitive.scala``; this is the TPU build's
+equivalent "hand kernel" for its hottest new op). The kernel implements the
+online-softmax attention forward tiled for VMEM:
+
+- grid = (batch*heads, query blocks); each program holds one query tile in
+  VMEM and streams key/value tiles for its (batch, head) row;
+- running (acc, row_sum, row_max) carried in f32 on the VPU, the two matmuls
+  per tile hit the MXU;
+- causal masking skips fully-masked key tiles (no FLOPs spent above the
+  diagonal).
+
+Backward uses recomputation: a ``jax.custom_vjp`` whose bwd re-runs the
+memory-light blockwise XLA formulation under ``jax.checkpoint`` semantics
+(FLOPs traded for HBM, the standard flash training recipe).
+
+On CPU the same kernel runs in Pallas interpret mode (tests); dispatch via
+``use_flash`` only selects it on real TPU backends by default.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG = float(jnp.finfo(jnp.float32).min)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, sk: int,
+                causal: bool, scale: float, block_q: int):
+    # q_ref: (1, BQ, D); k_ref/v_ref: (1, Sk_pad, D); o_ref: (1, BQ, D)
+    j = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale                # (BQ, D)
+    bq, d = q.shape
+    nkb = k_ref.shape[1] // block_k
+
+    q_pos = j * block_q + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(kb, carry):
+        acc, rsum, rmax = carry
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        logits = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32)
+        k_pos = kb * block_k + lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        valid = k_pos < sk
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        logits = jnp.where(valid, logits, _NEG)
+        blk_max = jnp.max(logits, axis=-1)
+        new_max = jnp.maximum(rmax, blk_max)
+        p = jnp.exp(logits - new_max[:, None])
+        dead = new_max <= _NEG / 2                      # all-masked row so far
+        p = jnp.where(dead[:, None], 0.0, p)
+        corr = jnp.where(dead, 1.0, jnp.exp(rmax - new_max))
+        new_sum = rsum * corr + jnp.sum(p, axis=-1)
+        pv = jnp.dot(p, vblk, preferred_element_type=jnp.float32)
+        new_acc = acc * corr[:, None] + pv
+        return new_acc, new_sum, new_max
+
+    if causal:
+        # Key tiles strictly above the diagonal contribute nothing: the last
+        # key position this query tile can see is its own last row.
+        last_q = j * block_q + bq - 1
+        nkb_eff = lax.min(nkb, lax.div(last_q, block_k) + 1)
+    else:
+        nkb_eff = nkb
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    sum0 = jnp.zeros((bq,), jnp.float32)
+    max0 = jnp.full((bq,), _NEG, jnp.float32)
+    acc, rsum, _ = lax.fori_loop(0, nkb_eff, body, (acc0, sum0, max0))
+    rsum = jnp.maximum(rsum, 1e-37)
+    o_ref[0] = (acc / rsum[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    # BSND -> (B*N, S, D): one grid row per (batch, head).
+    qt = q.transpose(0, 2, 1, 3).reshape(b * n, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * n, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * n, sk, d)
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, pad_k), (0, 0)))
+    sq_p, sk_p = qt.shape[1], kt.shape[1]
+
+    grid = (b * n, sq_p // block_q)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=block_k, sk=sk,
+                          causal=causal, scale=scale, block_q=block_q),
+        out_shape=jax.ShapeDtypeStruct((b * n, sq_p, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk_p, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qt, kt, vt)
+    out = out[:, :sq].reshape(b, n, sq, d).transpose(0, 2, 1, 3)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    from bigdl_tpu.ops.attention_core import blockwise_attention
+    q, k, v = res
+    f = lambda q_, k_, v_: blockwise_attention(
+        q_, k_, v_, causal=causal, scale=scale, block_size=block_k)
+    _, vjp = jax.vjp(jax.checkpoint(f), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 256, block_k: int = 256,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Flash attention, shapes (B, S, N, D); differentiable."""
+    if scale is None:
+        scale = 1.0 / float(q.shape[-1]) ** 0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def use_flash(q, mask) -> bool:
+    """Dispatch policy for MultiHeadAttention: Pallas kernel on real TPU for
+    long unmasked sequences (masked paths use the XLA cores which take an
+    arbitrary additive bias)."""
+    if os.environ.get("BIGDL_TPU_DISABLE_FLASH"):
+        return False
+    if mask is not None:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    seq, d = q.shape[1], q.shape[-1]
+    return seq >= 512 and d % 128 == 0 and seq % 128 == 0

@@ -1,0 +1,15 @@
+"""bigdl_tpu.optim — optimization layer (reference ``$B/optim/``)."""
+
+from bigdl_tpu.optim.methods import (
+    OptimMethod, SGD, Adagrad, Adam, Adamax, Adadelta, RMSprop, LBFGS,
+    LearningRateSchedule, Default, Poly, Step, MultiStep, EpochStep,
+    EpochDecay, Regime, EpochSchedule, Warmup,
+)
+from bigdl_tpu.optim.triggers import Trigger
+from bigdl_tpu.optim.validation import (
+    ValidationMethod, ValidationResult, AccuracyResult, LossResult,
+    Top1Accuracy, Top5Accuracy, Loss,
+)
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer
+from bigdl_tpu.optim.evaluator import Evaluator, Predictor

@@ -1,0 +1,469 @@
+"""Optimization methods (reference ``optim/SGD.scala:29``, ``Adam.scala:26``,
+``Adagrad.scala:31``, ``Adamax.scala:26``, ``Adadelta.scala:25``,
+``RMSprop.scala:25``, ``Ftrl``-absent, ``LBFGS.scala:38``).
+
+Design: each method is a *pure* (init_state, update) pair over parameter
+pytrees — the shape jit/grad needs — wrapped in an object that also carries
+the reference's Table-style hyper-parameters. The reference's
+``optimize(feval, x, config, state)`` imperative entry exists too (used by
+the LBFGS path and tests), built on the pure core.
+
+Learning-rate schedules (reference ``SGD.scala:147-295``) are pure functions
+of the traced step/epoch counters, so schedule changes never trigger a
+recompile.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.utils.table import Table, T
+
+
+# --------------------------------------------------------------------------
+# Learning-rate schedules (reference SGD inner classes)
+# --------------------------------------------------------------------------
+
+class LearningRateSchedule:
+    def rate(self, base_lr, state: Dict[str, Any]):
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """lr / (1 + neval·decay) (reference ``SGD.Default``)."""
+
+    def rate(self, base_lr, state):
+        decay = state.get("learningRateDecay", 0.0)
+        return base_lr / (1.0 + state["evalCounter"] * decay)
+
+
+class Poly(LearningRateSchedule):
+    """lr·(1 - iter/max)^power (reference ``SGD.Poly``)."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power, self.max_iteration = power, max_iteration
+
+    def rate(self, base_lr, state):
+        it = jnp.minimum(state["evalCounter"], self.max_iteration)
+        return base_lr * (1.0 - it / self.max_iteration) ** self.power
+
+
+class Step(LearningRateSchedule):
+    """lr·gamma^(floor(iter/stepSize)) (reference ``SGD.Step``)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def rate(self, base_lr, state):
+        return base_lr * self.gamma ** jnp.floor(state["evalCounter"] / self.step_size)
+
+
+class MultiStep(LearningRateSchedule):
+    """lr·gamma^(#milestones passed)."""
+
+    def __init__(self, step_sizes, gamma: float):
+        self.step_sizes = jnp.asarray(step_sizes)
+        self.gamma = gamma
+
+    def rate(self, base_lr, state):
+        passed = jnp.sum(state["evalCounter"] >= self.step_sizes)
+        return base_lr * self.gamma ** passed
+
+
+class EpochStep(LearningRateSchedule):
+    """lr·gamma^(floor(epoch/stepSize)) (reference ``SGD.EpochStep``)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def rate(self, base_lr, state):
+        return base_lr * self.gamma ** jnp.floor((state["epoch"] - 1) / self.step_size)
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr·0.1^decay(epoch) with a user decay fn (reference ``SGD.EpochDecay``).
+    The decay fn must be jax-traceable (int epoch array -> float)."""
+
+    def __init__(self, decay_fn: Callable):
+        self.decay_fn = decay_fn
+
+    def rate(self, base_lr, state):
+        return base_lr * 0.1 ** self.decay_fn(state["epoch"])
+
+
+class Regime:
+    """One row of an epoch-range schedule (reference ``SGD.Regime``)."""
+
+    def __init__(self, start_epoch: int, end_epoch: int, config: Table):
+        self.start_epoch, self.end_epoch = start_epoch, end_epoch
+        self.config = config
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Piecewise-per-epoch hyper config (reference ``SGD.EpochSchedule``)."""
+
+    def __init__(self, regimes):
+        self.regimes = list(regimes)
+
+    def rate(self, base_lr, state):
+        lr = base_lr
+        for r in self.regimes:
+            lr_r = r.config.get("learningRate", base_lr)
+            in_range = (state["epoch"] >= r.start_epoch) & (state["epoch"] <= r.end_epoch)
+            lr = jnp.where(in_range, lr_r, lr)
+        return lr
+
+    def weight_decay(self, base_wd, state):
+        wd = base_wd
+        for r in self.regimes:
+            wd_r = r.config.get("weightDecay", base_wd)
+            in_range = (state["epoch"] >= r.start_epoch) & (state["epoch"] <= r.end_epoch)
+            wd = jnp.where(in_range, wd_r, wd)
+        return wd
+
+
+class Warmup(LearningRateSchedule):
+    """Linear warmup then delegate (common TPU-scale recipe; no reference
+    equivalent — large-batch training needs it)."""
+
+    def __init__(self, warmup_iterations: int, after: LearningRateSchedule):
+        self.warmup_iterations = warmup_iterations
+        self.after = after
+
+    def rate(self, base_lr, state):
+        it = state["evalCounter"]
+        warm = base_lr * (it + 1) / self.warmup_iterations
+        return jnp.where(it < self.warmup_iterations, warm,
+                         self.after.rate(base_lr, state))
+
+
+# --------------------------------------------------------------------------
+# OptimMethod protocol
+# --------------------------------------------------------------------------
+
+def _tree_zeros(params):
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+class OptimMethod:
+    """Base optimizer (reference ``optim/OptimMethod.scala:25``)."""
+
+    def __init__(self, learningrate: float = 1e-3, weightdecay: float = 0.0):
+        self.learningrate = learningrate
+        self.weightdecay = weightdecay
+
+    # pure core ------------------------------------------------------------
+    def init_state(self, params) -> Dict[str, Any]:
+        return {"evalCounter": jnp.asarray(0, jnp.int32),
+                "epoch": jnp.asarray(1, jnp.int32)}
+
+    def update(self, grads, state, params) -> Tuple[Any, Dict[str, Any]]:
+        raise NotImplementedError
+
+    def _decayed(self, grads, params):
+        if self.weightdecay:
+            return jax.tree_util.tree_map(
+                lambda g, p: g + self.weightdecay * p, grads, params)
+        return grads
+
+    # reference-style imperative entry --------------------------------------
+    def optimize(self, feval: Callable, x, state: Optional[Dict] = None):
+        """Torch-style: feval(x) -> (loss, grad); returns (new_x, [loss]).
+
+        Used by tests and the LBFGS-style drivers; the training loops use the
+        pure ``update`` inside one jitted step instead.
+        """
+        if state is None:
+            state = getattr(self, "_state", None)
+            if state is None:
+                state = self.init_state(x)
+        loss, grad = feval(x)
+        new_x, new_state = self.update(grad, state, x)
+        self._state = new_state
+        return new_x, [loss]
+
+    def get_hyper_parameter(self) -> Table:
+        return T(learningRate=self.learningrate, weightDecay=self.weightdecay)
+
+
+class SGD(OptimMethod):
+    """SGD with momentum/nesterov/dampening and pluggable LR schedules
+    (reference ``optim/SGD.scala:29``)."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_decay: float = 0.0,
+                 weightdecay: float = 0.0,
+                 momentum: float = 0.0,
+                 dampening: Optional[float] = None,
+                 nesterov: bool = False,
+                 learningrate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learningrate, weightdecay)
+        self.learningrate_decay = learningrate_decay
+        self.momentum = momentum
+        self.dampening = dampening if dampening is not None else momentum
+        self.nesterov = nesterov
+        if nesterov:
+            assert momentum > 0 and self.dampening == 0, \
+                "nesterov requires momentum>0, dampening=0"
+        self.schedule = learningrate_schedule or Default()
+
+    def init_state(self, params):
+        s = super().init_state(params)
+        s["learningRateDecay"] = jnp.asarray(self.learningrate_decay)
+        if self.momentum > 0:
+            s["velocity"] = _tree_zeros(params)
+        return s
+
+    def current_rate(self, state):
+        return self.schedule.rate(self.learningrate, state)
+
+    def update(self, grads, state, params):
+        lr = self.current_rate(state)
+        wd = self.weightdecay
+        if isinstance(self.schedule, EpochSchedule):
+            wd = self.schedule.weight_decay(wd, state)
+        grads = jax.tree_util.tree_map(lambda g, p: g + wd * p, grads, params) \
+            if (self.weightdecay or isinstance(self.schedule, EpochSchedule)) else grads
+        new_state = dict(state)
+        if self.momentum > 0:
+            mu, damp = self.momentum, self.dampening
+
+            def vel(v, g):
+                return mu * v + (1 - damp) * g
+
+            v_new = jax.tree_util.tree_map(vel, state["velocity"], grads)
+            if self.nesterov:
+                step_dir = jax.tree_util.tree_map(
+                    lambda g, v: g + mu * v, grads, v_new)
+            else:
+                step_dir = v_new
+            new_state["velocity"] = v_new
+        else:
+            step_dir = grads
+        new_params = jax.tree_util.tree_map(
+            lambda p, d: p - lr * d, params, step_dir)
+        new_state["evalCounter"] = state["evalCounter"] + 1
+        return new_params, new_state
+
+
+class Adagrad(OptimMethod):
+    """reference ``optim/Adagrad.scala:31``."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_decay: float = 0.0,
+                 weightdecay: float = 0.0):
+        super().__init__(learningrate, weightdecay)
+        self.learningrate_decay = learningrate_decay
+
+    def init_state(self, params):
+        s = super().init_state(params)
+        s["accum"] = _tree_zeros(params)
+        return s
+
+    def update(self, grads, state, params):
+        grads = self._decayed(grads, params)
+        lr = self.learningrate / (1.0 + state["evalCounter"] * self.learningrate_decay)
+        accum = jax.tree_util.tree_map(lambda a, g: a + g * g, state["accum"], grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g, a: p - lr * g / (jnp.sqrt(a) + 1e-10), params, grads, accum)
+        return new_params, {**state, "accum": accum,
+                            "evalCounter": state["evalCounter"] + 1}
+
+
+class Adam(OptimMethod):
+    """reference ``optim/Adam.scala:26`` (bias-corrected)."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, weightdecay: float = 0.0):
+        super().__init__(learningrate, weightdecay)
+        self.learningrate_decay = learningrate_decay
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        s = super().init_state(params)
+        s["m"] = _tree_zeros(params)
+        s["v"] = _tree_zeros(params)
+        return s
+
+    def update(self, grads, state, params):
+        grads = self._decayed(grads, params)
+        t = state["evalCounter"] + 1
+        lr = self.learningrate / (1.0 + state["evalCounter"] * self.learningrate_decay)
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        v = jax.tree_util.tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], grads)
+        bc1 = 1 - b1 ** t.astype(jnp.float32)
+        bc2 = 1 - b2 ** t.astype(jnp.float32)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m_, v_: p - lr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.epsilon),
+            params, m, v)
+        return new_params, {**state, "m": m, "v": v, "evalCounter": t}
+
+
+class Adamax(OptimMethod):
+    """reference ``optim/Adamax.scala:26``."""
+
+    def __init__(self, learningrate: float = 0.002,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-38, weightdecay: float = 0.0):
+        super().__init__(learningrate, weightdecay)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        s = super().init_state(params)
+        s["m"] = _tree_zeros(params)
+        s["u"] = _tree_zeros(params)
+        return s
+
+    def update(self, grads, state, params):
+        grads = self._decayed(grads, params)
+        t = state["evalCounter"] + 1
+        b1 = self.beta1
+        m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        u = jax.tree_util.tree_map(
+            lambda u_, g: jnp.maximum(self.beta2 * u_, jnp.abs(g) + self.epsilon),
+            state["u"], grads)
+        bc1 = 1 - b1 ** t.astype(jnp.float32)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m_, u_: p - (self.learningrate / bc1) * m_ / u_, params, m, u)
+        return new_params, {**state, "m": m, "u": u, "evalCounter": t}
+
+
+class Adadelta(OptimMethod):
+    """reference ``optim/Adadelta.scala:25``."""
+
+    def __init__(self, decayrate: float = 0.9, epsilon: float = 1e-10):
+        super().__init__(learningrate=1.0)
+        self.rho, self.epsilon = decayrate, epsilon
+
+    def init_state(self, params):
+        s = super().init_state(params)
+        s["accum"] = _tree_zeros(params)
+        s["delta_accum"] = _tree_zeros(params)
+        return s
+
+    def update(self, grads, state, params):
+        rho, eps = self.rho, self.epsilon
+        accum = jax.tree_util.tree_map(
+            lambda a, g: rho * a + (1 - rho) * g * g, state["accum"], grads)
+        delta = jax.tree_util.tree_map(
+            lambda d, a, g: jnp.sqrt(d + eps) / jnp.sqrt(a + eps) * g,
+            state["delta_accum"], accum, grads)
+        delta_accum = jax.tree_util.tree_map(
+            lambda d, dl: rho * d + (1 - rho) * dl * dl, state["delta_accum"], delta)
+        new_params = jax.tree_util.tree_map(lambda p, d: p - d, params, delta)
+        return new_params, {**state, "accum": accum, "delta_accum": delta_accum,
+                            "evalCounter": state["evalCounter"] + 1}
+
+
+class RMSprop(OptimMethod):
+    """reference ``optim/RMSprop.scala:25``."""
+
+    def __init__(self, learningrate: float = 1e-2,
+                 learningrate_decay: float = 0.0,
+                 decayrate: float = 0.99, epsilon: float = 1e-8):
+        super().__init__(learningrate)
+        self.learningrate_decay = learningrate_decay
+        self.rho, self.epsilon = decayrate, epsilon
+
+    def init_state(self, params):
+        s = super().init_state(params)
+        s["accum"] = _tree_zeros(params)
+        return s
+
+    def update(self, grads, state, params):
+        lr = self.learningrate / (1.0 + state["evalCounter"] * self.learningrate_decay)
+        accum = jax.tree_util.tree_map(
+            lambda a, g: self.rho * a + (1 - self.rho) * g * g, state["accum"], grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g, a: p - lr * g / (jnp.sqrt(a) + self.epsilon),
+            params, grads, accum)
+        return new_params, {**state, "accum": accum,
+                            "evalCounter": state["evalCounter"] + 1}
+
+
+class LBFGS(OptimMethod):
+    """Limited-memory BFGS with optional line search
+    (reference ``optim/LBFGS.scala:38`` + ``LineSearch.scala``).
+
+    Full-batch second-order method; runs as a host-side loop around a jitted
+    (loss, grad) evaluation — the natural TPU split, since the two-loop
+    recursion is O(m·n) vector work best left to XLA but the control flow is
+    data-dependent.
+    """
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
+                 tolfun: float = 1e-5, tolx: float = 1e-9,
+                 ncorrection: int = 100, learningrate: float = 1.0,
+                 linesearch: bool = False):
+        super().__init__(learningrate)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 1.25
+        self.tolfun, self.tolx = tolfun, tolx
+        self.ncorrection = ncorrection
+        self.linesearch = linesearch
+
+    def optimize(self, feval, x, state=None):
+        from jax.flatten_util import ravel_pytree
+        x_flat, unravel = ravel_pytree(x)
+        loss, g = feval(x)
+        g_flat, _ = ravel_pytree(g)
+        losses = [float(loss)]
+        old_dirs, old_steps = [], []
+        H_diag = 1.0
+        prev_flat, prev_g = x_flat, g_flat
+        n_eval = 1
+        for it in range(self.max_iter):
+            if jnp.max(jnp.abs(g_flat)) <= self.tolfun:
+                break
+            if it == 0:
+                d = -g_flat
+            else:
+                y = g_flat - prev_g
+                s = x_flat - prev_flat
+                ys = jnp.dot(y, s)
+                if ys > 1e-10:
+                    if len(old_dirs) >= self.ncorrection:
+                        old_dirs.pop(0)
+                        old_steps.pop(0)
+                    old_dirs.append(y)
+                    old_steps.append(s)
+                    H_diag = ys / jnp.dot(y, y)
+                # two-loop recursion
+                k = len(old_dirs)
+                ro = [1.0 / jnp.dot(old_dirs[i], old_steps[i]) for i in range(k)]
+                q = -g_flat
+                al = [None] * k
+                for i in range(k - 1, -1, -1):
+                    al[i] = jnp.dot(old_steps[i], q) * ro[i]
+                    q = q - al[i] * old_dirs[i]
+                d = q * H_diag
+                for i in range(k):
+                    be_i = jnp.dot(old_dirs[i], d) * ro[i]
+                    d = d + (al[i] - be_i) * old_steps[i]
+            prev_flat, prev_g, prev_loss = x_flat, g_flat, loss
+            gtd = jnp.dot(g_flat, d)
+            if gtd > -self.tolx:
+                break
+            t = min(1.0, 1.0 / float(jnp.sum(jnp.abs(g_flat)))) \
+                if it == 0 else self.learningrate
+            x_flat = x_flat + t * d
+            loss, g = feval(unravel(x_flat))
+            g_flat, _ = ravel_pytree(g)
+            n_eval += 1
+            losses.append(float(loss))
+            if n_eval >= self.max_eval:
+                break
+            if jnp.abs(loss - prev_loss) < self.tolfun:
+                break
+            if jnp.max(jnp.abs(t * d)) <= self.tolx:
+                break
+        return unravel(x_flat), losses
+
+    def update(self, grads, state, params):  # pragma: no cover - not iterative
+        raise NotImplementedError("LBFGS uses optimize(feval, x)")

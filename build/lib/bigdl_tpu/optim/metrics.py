@@ -1,0 +1,44 @@
+"""Named performance counters (reference ``optim/Metrics.scala:31``).
+
+The reference backs these with Spark accumulators (driver-aggregated);
+here they are host-side counters the training loops feed with phase timings
+(data wait, step wall-clock, eval). ``summary()`` prints the same style of
+per-phase report the reference dumps at debug level
+(``DistriOptimizer.scala:283``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._agg: Dict[str, Tuple[float, int]] = {}
+
+    def set(self, name: str, value: float, parallel: int = 1) -> None:
+        with self._lock:
+            self._agg[name] = (value, parallel)
+
+    def add(self, name: str, value: float) -> None:
+        with self._lock:
+            v, n = self._agg.get(name, (0.0, 1))
+            self._agg[name] = (v + value, n)
+
+    def get(self, name: str) -> Tuple[float, int]:
+        with self._lock:
+            return self._agg.get(name, (0.0, 1))
+
+    def value(self, name: str) -> float:
+        v, n = self.get(name)
+        return v / max(1, n)
+
+    def summary(self, unit: str = "s", scale: float = 1.0) -> str:
+        with self._lock:
+            lines = ["========== Metrics Summary =========="]
+            for name, (v, n) in sorted(self._agg.items()):
+                lines.append(f"{name} : {v / max(1, n) / scale} {unit}")
+            lines.append("=====================================")
+            return "\n".join(lines)

@@ -1,0 +1,22 @@
+"""bigdl_tpu.parallel — the distributed plane (reference ``$B/parameters/`` +
+``DistriOptimizer``), rebuilt as mesh sharding + XLA collectives.
+
+The reference's communication backend is a parameter-sharded, fp16-compressed
+all-reduce over Spark BlockManager (``parameters/AllReduceParameter.scala``).
+Here every distributed strategy is a sharding layout over one
+``jax.sharding.Mesh`` and the collectives are XLA's (psum / all_gather /
+reduce_scatter / ppermute riding ICI) — plus new capabilities the reference
+lacks: tensor/pipeline/sequence(ring-attention)/expert parallelism.
+"""
+
+from bigdl_tpu.parallel.mesh import MeshTopology
+from bigdl_tpu.parallel.context import (
+    ring_attention, ulysses_attention, ring_self_attention)
+from bigdl_tpu.parallel.tensor_parallel import (
+    COLUMN, ROW, infer_param_specs)
+from bigdl_tpu.parallel.pipeline import (
+    PipelineStack, gpipe_loss_fn, pipeline_spec_tree)
+from bigdl_tpu.parallel.expert import MoE, expert_param_specs, inject_loss
+from bigdl_tpu.parallel.compression import (
+    CompressedTensor, SerializerInstance, fp32_to_bf16, bf16_to_fp32)
+from bigdl_tpu.parallel.model_broadcast import ModelBroadcast

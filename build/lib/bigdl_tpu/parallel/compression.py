@@ -1,0 +1,134 @@
+"""Host-side gradient/parameter compression codec
+(reference ``parameters/Parameter.scala:30,53`` + ``FP16CompressedTensor``).
+
+The reference compresses every gradient exchange to "fp16" — actually fp32
+truncated to its top 16 bits, i.e. **bfloat16** — and aggregates slices with
+multithreaded byte-loop adds. On TPU the *on-device* equivalent is casting
+collective payloads to ``jnp.bfloat16`` (``DistriOptimizer
+compress_gradients=True``); this module is the **host-side** codec for the
+places bytes still cross host boundaries — checkpoint payloads, model
+broadcast, cross-process parameter serving. Backed by the native C++ library
+(``bigdl_tpu.native``: ``bt_fp32_to_bf16``/``bt_bf16_add``/…) with a numpy
+fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu import native
+
+
+def _as_u16_ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
+def _as_f32_ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def fp32_to_bf16(src: np.ndarray) -> np.ndarray:
+    """Truncate fp32 → bf16 (uint16 view), reference ``truncate()``."""
+    src = np.ascontiguousarray(src, dtype=np.float32)
+    out = np.empty(src.shape, dtype=np.uint16)
+    lib = native.load()
+    if lib is not None:
+        lib.bt_fp32_to_bf16(_as_f32_ptr(src), _as_u16_ptr(out), src.size)
+    else:
+        out[...] = (src.view(np.uint32) >> 16).astype(np.uint16)
+    return out
+
+
+def bf16_to_fp32(src: np.ndarray) -> np.ndarray:
+    src = np.ascontiguousarray(src, dtype=np.uint16)
+    out = np.empty(src.shape, dtype=np.float32)
+    lib = native.load()
+    if lib is not None:
+        lib.bt_bf16_to_fp32(_as_u16_ptr(src), _as_f32_ptr(out), src.size)
+    else:
+        out[...] = (src.astype(np.uint32) << 16).view(np.float32)
+    return out
+
+
+class CompressedTensor:
+    """Byte-level compressed view of a flat fp32 vector
+    (reference ``CompressedTensor`` trait, ``Parameter.scala:30``)."""
+
+    def __init__(self, length: int):
+        self.length = length
+        self._data = np.zeros((length,), dtype=np.uint16)
+
+    # -- codec -------------------------------------------------------------
+    def compress(self, src: np.ndarray, offset: int = 0,
+                 length: Optional[int] = None) -> "CompressedTensor":
+        src = np.ascontiguousarray(src, dtype=np.float32).ravel()
+        n = src.size if length is None else length
+        self._data[offset:offset + n] = fp32_to_bf16(src[:n])
+        return self
+
+    def decompress(self, dst: Optional[np.ndarray] = None) -> np.ndarray:
+        out = bf16_to_fp32(self._data)
+        if dst is not None:
+            np.copyto(dst.ravel(), out)
+            return dst
+        return out
+
+    # -- aggregation (reference add/parAdd) --------------------------------
+    def add(self, other: "CompressedTensor", offset: int = 0,
+            length: Optional[int] = None) -> "CompressedTensor":
+        n = self.length - offset if length is None else length
+        a = self._data[offset:offset + n]
+        b = other._data[offset:offset + n]
+        lib = native.load()
+        if lib is not None and a.flags.c_contiguous and b.flags.c_contiguous:
+            lib.bt_bf16_add(_as_u16_ptr(a), _as_u16_ptr(b), n)
+        else:
+            widened = ((a.astype(np.uint32) << 16).view(np.float32)
+                       + (b.astype(np.uint32) << 16).view(np.float32))
+            a[...] = (widened.view(np.uint32) >> 16).astype(np.uint16)
+        return self
+
+    def accumulate_into(self, dst: np.ndarray, offset: int = 0) -> None:
+        """fp32 dst += bf16 self — fused slice aggregation."""
+        n = self.length
+        view = np.ascontiguousarray(dst.ravel()[offset:offset + n],
+                                    dtype=np.float32)
+        lib = native.load()
+        if lib is not None:
+            lib.bt_bf16_accumulate(_as_f32_ptr(view), _as_u16_ptr(self._data), n)
+        else:
+            view += bf16_to_fp32(self._data)
+        dst.ravel()[offset:offset + n] = view
+
+    # -- serialization -----------------------------------------------------
+    def bytes(self) -> bytes:
+        return self._data.tobytes()
+
+    @classmethod
+    def from_bytes(cls, payload: bytes) -> "CompressedTensor":
+        data = np.frombuffer(payload, dtype=np.uint16).copy()
+        out = cls(data.size)
+        out._data = data
+        return out
+
+    @classmethod
+    def from_array(cls, src: np.ndarray) -> "CompressedTensor":
+        out = cls(int(np.asarray(src).size))
+        return out.compress(np.asarray(src))
+
+
+class SerializerInstance:
+    """Codec registry by name (reference ``Parameter.scala:53``; only "fp16"
+    exists there — it IS bf16 truncation, so both names map to one codec)."""
+
+    _CODECS = {"fp16": CompressedTensor, "bf16": CompressedTensor}
+
+    @classmethod
+    def create(cls, length: int, pm: str = "bf16") -> CompressedTensor:
+        try:
+            return cls._CODECS[pm](length)
+        except KeyError:
+            raise ValueError(f"unsupported parameter type {pm}") from None

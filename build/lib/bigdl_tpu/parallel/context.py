@@ -1,0 +1,132 @@
+"""Sequence/context parallelism: ring attention and Ulysses (all-to-all).
+
+New TPU-native capability — the reference has none (SURVEY §5.7: "Sequence
+dim is never sharded across workers"; its long-sequence story stops at
+pad-to-max batching, ``dataset/Transformer.scala:105-275``). Here the
+sequence axis of attention is sharded over the mesh ``seq`` axis so context
+length scales with the number of chips:
+
+- **Ring attention** (`ring_attention`): every device keeps its query shard
+  resident and streams key/value shards around the ICI ring with
+  ``lax.ppermute``, folding each hop's partial attention into an
+  online-softmax accumulator (``ops/attention_core.online_softmax_combine``).
+  Peak memory per chip is O(S/P); the ring overlaps compute with
+  neighbor-to-neighbor ICI traffic, the layout collective-free XLA can't
+  derive itself.
+- **Ulysses** (`ulysses_attention`): two ``lax.all_to_all``s re-shard
+  (seq-sharded -> head-sharded), run ordinary full-sequence attention
+  locally per head group, and shard back. Cheaper for moderate S with
+  enough heads (head count must divide by the axis size).
+
+Both are called INSIDE ``shard_map`` bodies (the per-device view), with
+arrays sharded (B, S/P, N, D) on the named axis. ``ring_self_attention``
+wraps the whole thing in ``shard_map`` for single-call use and tests.
+
+Causal note: shards are contiguous sequence chunks, so with causal=True
+later devices do more work than earlier ones (the standard non-zigzag
+layout); a load-balanced permuted layout is a planned optimisation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.ops.attention_core import (
+    attention_partial, finalize_partial, online_softmax_combine)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Ring attention over the named mesh axis (call inside shard_map).
+
+    q, k, v: the local shard, (B, S/P, N, D); global sequence = P shards in
+    axis-index order. Returns the local (B, S/P, N, D) output shard —
+    bitwise the same math as full attention on the gathered sequence.
+    """
+    if scale is None:
+        scale = 1.0 / float(q.shape[-1]) ** 0.5
+    p = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    chunk = q.shape[1]
+    q_offset = my * chunk
+
+    # Start with the local chunk, then pull each neighbour's around the ring.
+    perm = [(i, (i + 1) % p) for i in range(p)]  # shard s lives on dev s+t at hop t
+
+    def hop(t, carry):
+        acc, rsum, rmax, kc, vc = carry
+        src = (my - t) % p  # which global chunk we hold at hop t
+        pa, ps, pm = attention_partial(q, kc, vc, scale,
+                                       k_offset=src * chunk,
+                                       q_offset=q_offset, causal=causal)
+        acc, rsum, rmax = online_softmax_combine(acc, rsum, rmax, pa, ps, pm)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return acc, rsum, rmax, kc, vc
+
+    b, s_loc, n, d = q.shape
+    neg = jnp.finfo(jnp.float32).min
+    acc = jnp.zeros((b, s_loc, n, d), jnp.float32)
+    rsum = jnp.zeros((b, n, s_loc), jnp.float32)
+    rmax = jnp.full((b, n, s_loc), neg, jnp.float32)
+    # Mark the zero-init carries as device-varying over the ring axis —
+    # required by shard_map's vma typing (the loop outputs vary over 'seq').
+    acc, rsum, rmax = (lax.pcast(x, (axis_name,), to="varying")
+                       for x in (acc, rsum, rmax))
+    acc, rsum, rmax, _, _ = lax.fori_loop(
+        0, p, hop, (acc, rsum, rmax, k, v))
+    return finalize_partial(acc, rsum).astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None) -> jax.Array:
+    """Ulysses sequence parallelism (call inside shard_map).
+
+    all_to_all turns the seq-sharded (B, S/P, N, D) into head-sharded
+    (B, S, N/P, D), runs full attention locally, and reverses. Requires
+    num_heads % axis_size == 0.
+    """
+    from bigdl_tpu.ops.attention_core import blockwise_attention
+    p = lax.axis_size(axis_name)
+    n = q.shape[2]
+    assert n % p == 0, f"heads {n} must divide seq axis size {p}"
+
+    def to_heads(x):   # (B, S/P, N, D) -> (B, S, N/P, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):     # (B, S, N/P, D) -> (B, S/P, N, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = blockwise_attention(qh, kh, vh, causal=causal, scale=scale,
+                              block_size=max(128, qh.shape[1] // 8))
+    return to_seq(out)
+
+
+def _wrap_shard_map(fn, mesh, axis_name):
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    spec = P(None, axis_name, None, None)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)
+
+
+def ring_self_attention(q, k, v, mesh, axis_name: str = "seq",
+                        causal: bool = False,
+                        scale: Optional[float] = None,
+                        mode: str = "ring") -> jax.Array:
+    """Whole-array convenience: shards (B, S, N, D) over ``axis_name`` of
+    ``mesh``, runs ring/Ulysses attention, returns the full array view."""
+    impl = {"ring": ring_attention, "ulysses": ulysses_attention}[mode]
+    fn = functools.partial(impl, axis_name=axis_name, causal=causal,
+                           scale=scale)
+    return _wrap_shard_map(fn, mesh, axis_name)(q, k, v)

@@ -1,0 +1,377 @@
+"""Distributed synchronous-SGD training over a device mesh
+(reference ``optim/DistriOptimizer.scala:669`` + ``parameters/AllReduceParameter.scala:62``).
+
+The reference runs, per iteration, two Spark jobs and three BlockManager
+block exchanges: fetch weight slices → local fwd/bwd → publish fp16 gradient
+slices → owners aggregate + update their slice → republish. On TPU the entire
+iteration is ONE jitted SPMD program; the exchanges become XLA collectives
+riding ICI:
+
+- ``sync_mode="allreduce"`` — replicated parameters, batch sharded over the
+  ``data`` axis; XLA's SPMD partitioner inserts the gradient psum. The two
+  intra-node tiers of the reference (executor slice exchange + per-core
+  replica reduce, ``DistriOptimizer.scala:112-115,229-246``) collapse into
+  this single psum.
+
+- ``sync_mode="sharded"`` — the AllReduceParameter slice-ownership model,
+  TPU-native (≙ ZeRO-1): the flat parameter vector is conceptually cut into
+  P slices; gradients ``psum_scatter`` so each device reduces only its own
+  slice, the optimizer updates that slice (optimizer state stays sharded —
+  P× less optimizer memory), and ``all_gather`` republishes the weights.
+  This is bit-for-bit the reference's protocol with BlockManager fetches
+  replaced by reduce-scatter/all-gather.
+
+bf16 gradient compression (reference ``FP16CompressedTensor``: fp32 truncated
+to its top 16 bits == bfloat16) maps to casting the collective payload to
+``jnp.bfloat16`` — ``compress_gradients=True``.
+
+BatchNorm note: in allreduce mode batch-stat means over the sharded batch are
+computed globally by XLA → synchronized BN across replicas (an upgrade over
+the reference's per-replica stats); in sharded mode new buffers are pmean'd.
+
+Multi-host: when ``Engine.init`` joined a jax.distributed topology (env
+``BIGDL_COORDINATOR_ADDRESS``/..., or TPU-pod auto-detect), the same jitted
+step spans every host's chips. Per-process ingest (``DistributedDataSet``
+record slices ≙ executor-pinned partitions) feeds
+``jax.make_array_from_process_local_data``; state is committed to the global
+mesh by ``_place_state``; checkpoints gather sharded leaves and write on
+process 0 only; validation merges per-host (numerator, count) pairs with one
+allgather. Verified by ``tests/test_multihost.py`` (2 real processes, gloo).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.nn.module import functional_apply
+from bigdl_tpu.optim.optimizer import LocalOptimizer, Optimizer, _regularizer_pairs, _reg_loss
+from bigdl_tpu.parallel.mesh import DATA_AXIS, TENSOR_AXIS, MeshTopology
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+
+class DistriOptimizer(LocalOptimizer):
+    """Mesh data-parallel optimizer (reference ``DistriOptimizer``)."""
+
+    def __init__(self, model, dataset, criterion,
+                 topology: Optional[MeshTopology] = None,
+                 sync_mode: str = "allreduce",
+                 compress_gradients: bool = False,
+                 **kwargs):
+        super().__init__(model, dataset, criterion, **kwargs)
+        self.topology = topology or MeshTopology.data_parallel()
+        self.sync_mode = sync_mode
+        self.compress_gradients = compress_gradients
+        if sync_mode == "sharded" and topology and any(
+                topology.sizes.get(ax, 1) > 1 for ax in ("tensor", "expert")):
+            raise ValueError("sync_mode='sharded' (ZeRO-1 flat slices) is a "
+                             "data-axis layout; combine tensor/expert "
+                             "parallelism with sync_mode='allreduce'")
+        self.mesh: Mesh = self.topology.build()
+        self._n_data = self.mesh.shape.get(DATA_AXIS, 1)
+        self._n_tensor = self.mesh.shape.get(TENSOR_AXIS, 1)
+        batch_spec = P(DATA_AXIS) if DATA_AXIS in self.mesh.shape else P()
+        self._batch_sharding = NamedSharding(self.mesh, batch_spec)
+        self._replicated = NamedSharding(self.mesh, P())
+
+    # ------------------------------------------------------------- placement
+    def _place_batch(self, batch):
+        """Commit one batch onto the mesh's data axis.
+
+        Single-host: the pipeline's batch IS the global batch — device_put
+        shards it. Multi-host: the pipeline yields this process's LOCAL
+        records only (``DistributedDataSet`` per-process slice ≙ the
+        reference's executor-pinned partitions, ``CachedDistriDataSet``);
+        ``jax.make_array_from_process_local_data`` assembles the global
+        array without any host ever holding the full batch."""
+        if jax.process_count() > 1:
+            data = jax.make_array_from_process_local_data(
+                self._batch_sharding, np.asarray(batch.data))
+            labels = jax.make_array_from_process_local_data(
+                self._batch_sharding, np.asarray(batch.labels))
+            return data, labels
+        data = jax.device_put(jnp.asarray(batch.data), self._batch_sharding)
+        labels = jax.device_put(jnp.asarray(batch.labels), self._batch_sharding)
+        return data, labels
+
+    def _place_state(self, params, buffers, opt_state):
+        """Commit training state onto the mesh (multi-host: host-local values
+        become global arrays; required before jit sees cross-process
+        shardings)."""
+        if jax.process_count() <= 1:
+            return params, buffers, opt_state
+        rep = self._replicated
+
+        def put_rep(x):
+            return jax.device_put(jnp.asarray(x), rep)
+
+        from jax.flatten_util import ravel_pytree
+        flat, _ = ravel_pytree(params)
+        full = flat.size + ((-flat.size) % self._n_data)
+        params = jax.tree_util.tree_map(put_rep, params)
+        buffers = jax.tree_util.tree_map(put_rep, buffers)
+        if self.sync_mode != "sharded":
+            opt_state = jax.tree_util.tree_map(put_rep, opt_state)
+        else:
+            # slice-shaped vector state lives over the data axis (ZeRO-1);
+            # scalar counters are replicated — same rule as _init_opt_state,
+            # applied to full-length (possibly checkpoint-resumed) leaves.
+            sliced = NamedSharding(self.mesh, P(DATA_AXIS))
+
+            def put_opt(x):
+                x = jnp.asarray(x)
+                if x.ndim >= 1 and x.shape[0] == full:
+                    return jax.device_put(x, sliced)
+                return put_rep(x)
+
+            opt_state = jax.tree_util.tree_map(put_opt, opt_state)
+        return params, buffers, opt_state
+
+    @staticmethod
+    def _fetch_host(x):
+        """Global array -> host value (multi-host safe): replicated arrays
+        read locally, axis-sharded ones gather via a process allgather."""
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            if not x.is_fully_replicated:
+                from jax.experimental import multihost_utils
+                return multihost_utils.process_allgather(x, tiled=True)
+        return np.asarray(x)
+
+    def _save_checkpoint(self, params, buffers, opt_state, driver_state):
+        if self.checkpoint_path is None:
+            return
+        if jax.process_count() > 1:
+            fetch = lambda t: jax.tree_util.tree_map(self._fetch_host, t)
+            # every process participates in the gather; only the 'driver'
+            # writes (reference: checkpoint written by the Spark driver)
+            params, buffers, opt_state = (fetch(params), fetch(buffers),
+                                          fetch(opt_state))
+            if jax.process_index() != 0:
+                return
+        super()._save_checkpoint(params, buffers, opt_state, driver_state)
+
+    def _run_validation(self, params, buffers, fwd):
+        """Multi-host: each process runs forward over ITS shard of the
+        validation set (the dataset must be distributed so records split by
+        process), then per-method (numerator, count) pairs merge via one
+        allgather — the TPU-native form of ``ValidationResult.+`` reduce
+        over executors (``optim/Evaluator.scala:48-73``)."""
+        if jax.process_count() <= 1:
+            return super()._run_validation(params, buffers, fwd)
+        from jax.experimental import multihost_utils
+        from bigdl_tpu.optim.evaluator import evaluate_batches
+
+        params_h = jax.tree_util.tree_map(
+            self._fetch_host, self._finalize_params(params))
+        buffers_h = jax.tree_util.tree_map(self._fetch_host, buffers)
+        if getattr(self, "_local_eval_fwd", None) is None:
+            model = self.model
+
+            def local_fwd(p, b, x):
+                out, _ = functional_apply(model, p, b, x, training=False)
+                return out
+
+            self._local_eval_fwd = jax.jit(local_fwd)
+        results, count = evaluate_batches(
+            self._local_eval_fwd, params_h, buffers_h,
+            self.validation_dataset.data(train=False),
+            self.validation_methods)
+        states = np.array(
+            [list(r.state()) if r is not None else [0.0, 0.0]
+             for r in results] + [[float(count), 0.0]], np.float64)
+        summed = multihost_utils.process_allgather(states).sum(axis=0)
+        merged = [
+            type(r).from_state(num, cnt) if r is not None else None
+            for r, (num, cnt) in zip(results, summed[:-1])]
+        return merged, int(summed[-1][0])
+
+    # ------------------------------------------------------------------ step
+    def _build_step(self) -> Callable:
+        if self.sync_mode == "sharded":
+            return self._build_sharded_step()
+        return self._build_allreduce_step()
+
+    def _build_allreduce_step(self) -> Callable:
+        model, criterion, optim = self.model, self.criterion, self.optim_method
+        reg_pairs = _regularizer_pairs(model)
+        compress = self.compress_gradients
+        policy = self.precision
+
+        def step(params, buffers, opt_state, rng, data, labels):
+            def loss_fn(p):
+                from bigdl_tpu.ops.precision import cast_tree
+                p_c = policy.cast_params_for_compute(p)
+                out, new_buf = functional_apply(model, p_c, buffers,
+                                                data,
+                                                training=True, rng=rng)
+                loss = criterion.apply(out, labels).astype(jnp.float32)
+                new_buf = cast_tree(new_buf, jnp.float32)
+                return loss + _reg_loss(p, reg_pairs), (new_buf, loss)
+
+            grads, (new_buf, loss) = jax.grad(loss_fn, has_aux=True)(params)
+            if compress:
+                # bf16 payload ≙ reference FP16CompressedTensor (truncated fp32)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.bfloat16).astype(g.dtype), grads)
+            new_params, new_opt_state = optim.update(grads, opt_state, params)
+            return new_params, new_buf, new_opt_state, loss
+
+        rep, bat = self._replicated, self._batch_sharding
+        if self._n_tensor > 1 or self.mesh.shape.get("expert", 1) > 1:
+            # Tensor/expert parallelism: per-leaf parameter shardings
+            # (Megatron column/row rules, MoE expert stacking); GSPMD
+            # inserts the activation collectives/all_to_alls. Optimizer
+            # state mirrors the param specs.
+            from bigdl_tpu.parallel.tensor_parallel import (
+                infer_param_specs, opt_state_specs)
+            params0 = self.model.parameter_tree()
+            p_specs = infer_param_specs(self.model,
+                                        axis_size=dict(self.mesh.shape))
+            state_tpl = jax.eval_shape(optim.init_state, params0)
+            s_specs = opt_state_specs(state_tpl, params0, p_specs)
+            named = lambda tree: jax.tree_util.tree_map(
+                lambda sp: NamedSharding(self.mesh, sp), tree,
+                is_leaf=lambda x: isinstance(x, P))
+            p_sh, s_sh = named(p_specs), named(s_specs)
+            return jax.jit(
+                step,
+                in_shardings=(p_sh, rep, s_sh, rep, bat, bat),
+                out_shardings=(p_sh, rep, s_sh, rep),
+                donate_argnums=(0, 1, 2))
+        return jax.jit(
+            step,
+            in_shardings=(rep, rep, rep, rep, bat, bat),
+            out_shardings=(rep, rep, rep, rep),
+            donate_argnums=(0, 1, 2))
+
+    def _build_sharded_step(self) -> Callable:
+        from jax.flatten_util import ravel_pytree
+        from jax import shard_map
+
+        model, criterion, optim = self.model, self.criterion, self.optim_method
+        reg_pairs = _regularizer_pairs(model)
+        compress = self.compress_gradients
+        mesh, n_dev = self.mesh, self._n_data
+
+        # Flat-parameter geometry (reference AllReduceParameter slice layout).
+        params0 = model.parameter_tree()
+        flat0, unravel = ravel_pytree(params0)
+        n = flat0.shape[0]
+        pad = (-n) % n_dev
+        chunk = (n + pad) // n_dev
+        self._unravel, self._n, self._pad = unravel, n, pad
+
+        # Per-leaf specs for the optimizer state: slice-shaped vector leaves
+        # are sharded over the data axis, scalar counters stay replicated.
+        opt_template = optim.init_state(jnp.zeros((chunk,), flat0.dtype))
+        opt_specs = jax.tree_util.tree_map(
+            lambda x: P(DATA_AXIS)
+            if (hasattr(x, "ndim") and np.ndim(x) >= 1 and np.shape(x)[0] == chunk)
+            else P(),
+            opt_template)
+
+        policy = self.precision
+
+        def spmd_step(flat_params, buffers, opt_state, rng, data, labels):
+            # flat_params: full replicated flat vector (post all-gather state).
+            params = unravel(flat_params[:n])
+
+            def loss_fn(p):
+                from bigdl_tpu.ops.precision import cast_tree
+                p_c = policy.cast_params_for_compute(p)
+                out, new_buf = functional_apply(model, p_c, buffers,
+                                                data,
+                                                training=True, rng=rng)
+                loss = criterion.apply(out, labels).astype(jnp.float32)
+                new_buf = cast_tree(new_buf, jnp.float32)
+                return loss + _reg_loss(p, reg_pairs), (new_buf, loss)
+
+            grads, (new_buf, loss) = jax.grad(loss_fn, has_aux=True)(params)
+            flat_grads, _ = ravel_pytree(grads)
+            flat_grads = jnp.pad(flat_grads, (0, pad))
+            if compress:
+                flat_grads = flat_grads.astype(jnp.bfloat16)
+            # reduce-scatter: each device reduces ONLY its own slice
+            # (≙ aggregrateGradientPartition, AllReduceParameter.scala:172-210)
+            grad_slice = jax.lax.psum_scatter(
+                flat_grads, DATA_AXIS, scatter_dimension=0, tiled=True) / n_dev
+            grad_slice = grad_slice.astype(jnp.float32)
+            rank = jax.lax.axis_index(DATA_AXIS)
+            param_slice = jax.lax.dynamic_slice(flat_params, (rank * chunk,), (chunk,))
+            new_slice, new_opt_state = optim.update(grad_slice, opt_state, param_slice)
+            # republish slices (≙ sendWeightPartition + getWeights)
+            new_flat = jax.lax.all_gather(new_slice, DATA_AXIS, tiled=True)
+            new_buf = jax.tree_util.tree_map(
+                lambda b: jax.lax.pmean(b, DATA_AXIS), new_buf)
+            loss = jax.lax.pmean(loss, DATA_AXIS)
+            return new_flat, new_buf, new_opt_state, loss
+
+        sharded = shard_map(
+            spmd_step, mesh=mesh,
+            in_specs=(P(), P(), opt_specs, P(), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P(), opt_specs, P()),
+            check_vma=False)
+        jitted = jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+        def step(params, buffers, opt_state, rng, data, labels):
+            # params arrives as a pytree on the first call; thereafter flat.
+            if not isinstance(params, jax.Array):
+                flat, _ = ravel_pytree(params)
+                flat = jnp.pad(flat, (0, pad))
+                params = jax.device_put(flat, self._replicated)
+            new_flat, new_buf, new_opt, loss = jitted(
+                params, buffers, opt_state, rng, data, labels)
+            return new_flat, new_buf, new_opt, loss
+
+        step.finalize = lambda flat: unravel(flat[:n])  # flat -> pytree
+        return step
+
+    def _build_forward(self) -> Callable:
+        model = self.model
+        unravel = getattr(self, "_unravel", None)
+        n = getattr(self, "_n", None)
+
+        def fwd(params, buffers, data):
+            if unravel is not None and isinstance(params, jax.Array):
+                params = unravel(params[:n])
+            out, _ = functional_apply(model, params, buffers, data, training=False)
+            return out
+
+        rep, bat = self._replicated, self._batch_sharding
+        return jax.jit(fwd, in_shardings=(rep, rep, bat), out_shardings=bat)
+
+    # ------------------------------------------------------- optimizer state
+    def _init_opt_state(self, params):
+        if self.sync_mode != "sharded":
+            return super()._init_opt_state(params)
+        # Per-slice optimizer state: P× less memory (ZeRO-1), sharded layout.
+        from jax.flatten_util import ravel_pytree
+        flat, _ = ravel_pytree(params)
+        n = flat.shape[0]
+        pad = (-n) % self._n_data
+        chunk = (n + pad) // self._n_data
+        slice_proto = jnp.zeros((chunk,), flat.dtype)
+        state = self.optim_method.init_state(slice_proto)
+        # Broadcast scalar counters, shard vector state over the data axis.
+
+        def place(x):
+            x = jnp.asarray(x)
+            if x.ndim >= 1 and x.shape[0] == chunk:
+                tiled = jnp.tile(x, (self._n_data,) + (1,) * (x.ndim - 1)) \
+                    if x.ndim > 1 else jnp.tile(x, self._n_data)
+                return jax.device_put(tiled, NamedSharding(self.mesh, P(DATA_AXIS)))
+            return jax.device_put(x, self._replicated)
+
+        return jax.tree_util.tree_map(place, state)
+
+    def _finalize_params(self, params):
+        if self.sync_mode == "sharded" and isinstance(params, jax.Array):
+            return self._unravel(np.asarray(params)[:self._n])
+        return params

@@ -1,0 +1,76 @@
+"""Mesh topology: the distributed analogue of ``utils/Engine.scala``'s
+(nodes × cores) model.
+
+A ``MeshTopology`` names up to five axes — data, tensor (model), pipeline,
+sequence (context), expert — over the available devices. The reference only
+ever has the data axis (sync SGD over executors); the others are new
+capabilities. Axis sizes must multiply to the device count; size-1 axes are
+dropped so XLA sees the smallest mesh that expresses the layout.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+DATA_AXIS = "data"
+TENSOR_AXIS = "tensor"
+PIPELINE_AXIS = "pipe"
+SEQUENCE_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+_CANONICAL_ORDER = (DATA_AXIS, PIPELINE_AXIS, EXPERT_AXIS, SEQUENCE_AXIS, TENSOR_AXIS)
+
+
+class MeshTopology:
+    """Factory for `jax.sharding.Mesh` with named parallelism axes.
+
+    Axis order puts the most communication-hungry axis (tensor) innermost so
+    its collectives ride the fastest ICI links — the standard TPU layout
+    recipe (cf. the scaling-book mesh ordering).
+    """
+
+    def __init__(self, data: int = 1, tensor: int = 1, pipeline: int = 1,
+                 sequence: int = 1, expert: int = 1,
+                 devices: Optional[Sequence] = None):
+        sizes = {DATA_AXIS: data, TENSOR_AXIS: tensor, PIPELINE_AXIS: pipeline,
+                 SEQUENCE_AXIS: sequence, EXPERT_AXIS: expert}
+        for k, v in sizes.items():
+            assert v >= 1, f"axis {k} must be >= 1"
+        self.sizes = sizes
+        self._devices = devices
+
+    @staticmethod
+    def data_parallel(n_devices: Optional[int] = None) -> "MeshTopology":
+        from bigdl_tpu.utils.engine import Engine
+        n = n_devices if n_devices is not None else Engine.device_count()
+        return MeshTopology(data=n)
+
+    def total(self) -> int:
+        t = 1
+        for v in self.sizes.values():
+            t *= v
+        return t
+
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(a for a in _CANONICAL_ORDER if self.sizes[a] > 1) or (DATA_AXIS,)
+
+    def build(self):
+        """Construct the `jax.sharding.Mesh`."""
+        import jax
+        from jax.sharding import Mesh
+
+        devices = list(self._devices) if self._devices is not None else jax.devices()
+        n = self.total()
+        assert len(devices) >= n, (
+            f"mesh needs {n} devices, have {len(devices)}")
+        names = self.axis_names()
+        shape = tuple(self.sizes[a] for a in names)
+        dev_array = np.asarray(devices[:n]).reshape(shape)
+        return Mesh(dev_array, names)
+
+    def __repr__(self):
+        parts = ", ".join(f"{a}={self.sizes[a]}" for a in _CANONICAL_ORDER
+                          if self.sizes[a] > 1)
+        return f"MeshTopology({parts or 'data=1'})"

@@ -1,0 +1,170 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the mesh ``pipe`` axis.
+
+New capability — the reference has none (SURVEY §2.5: "Pipeline parallelism:
+ABSENT"). TPU-native design:
+
+- A deep model is expressed as ``PipelineStack``: ``depth`` repetitions of a
+  homogeneous block whose parameters are STACKED on a leading layer axis
+  (leaves shaped (depth, ...)). Single-device forward is a ``lax.scan`` over
+  the layer axis (this is also the memory-friendly way to run deep
+  transformers on one chip — one compiled block body, not ``depth`` inlined
+  copies).
+- Under pipeline parallelism the layer axis is simply SHARDED over the mesh
+  ``pipe`` axis (spec ``P('pipe', ...)``): each device owns
+  ``depth/P`` contiguous layers = one stage. ``gpipe_loss_fn`` runs the
+  GPipe schedule inside ``shard_map``: microbatches enter stage 0, march
+  stage-to-stage via ``lax.ppermute`` (neighbour ICI hops), and the bubble
+  costs (P-1)/(M+P-1) of the wall clock. ``jax.grad`` through the schedule
+  IS the backward pipeline — ppermute's transpose reverses the ring, so the
+  1F1B-style reverse traffic needs no extra code.
+
+The stacked layout means pipeline parallelism here is a *sharding choice*
+over the same arrays as single-chip execution — switching P requires no
+re-partitioning of the model definition, matching the framework's "one mesh,
+many layouts" design.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module, functional_apply
+from bigdl_tpu.parallel.mesh import PIPELINE_AXIS
+
+
+class PipelineStack(Module):
+    """``depth`` copies of ``block`` with parameters stacked on axis 0.
+
+    ``block_factory()`` must build a block whose output shape equals its
+    input shape (transformer blocks, residual conv blocks) and which carries
+    no buffers (BatchNorm: use LayerNorm/GroupNorm instead — running stats
+    across pipeline stages are not well-defined under microbatching).
+    """
+
+    def __init__(self, block_factory: Callable[[], Module], depth: int):
+        super().__init__()
+        self.depth = depth
+        self.block = block_factory()
+        assert not self.block.buffer_tree(), (
+            "PipelineStack blocks must be buffer-free (no BatchNorm)")
+        per_layer = []
+        for _ in range(depth):
+            per_layer.append(block_factory().parameter_tree())
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *per_layer)
+        self._stacked = stacked  # dict tree; leaves (depth, ...)
+
+    # The stacked tree IS this module's parameters.
+    def parameter_tree(self) -> Dict[str, Any]:
+        return self._stacked
+
+    def load_parameter_tree(self, tree) -> None:
+        self._stacked = tree
+
+    def buffer_tree(self) -> Dict[str, Any]:
+        return {}
+
+    def load_buffer_tree(self, tree) -> None:
+        pass
+
+    def scan_apply(self, params, x, training: bool = False):
+        """Sequential (single-device) forward: scan over the layer axis."""
+        block = self.block
+
+        def body(h, layer_params):
+            out, _ = functional_apply(block, layer_params, {}, h,
+                                      training=training)
+            return out, None
+
+        out, _ = lax.scan(body, x, params)
+        return out
+
+    def update_output(self, input):
+        return self.scan_apply(self.parameter_tree(), input,
+                               training=self.training)
+
+    def __repr__(self):
+        return f"PipelineStack(depth={self.depth}, block={self.block!r})"
+
+
+def pipeline_spec_tree(stack: PipelineStack, axis: str = PIPELINE_AXIS):
+    """PartitionSpecs sharding the stacked layer axis over ``pipe``."""
+    from jax.sharding import PartitionSpec as P
+    return jax.tree_util.tree_map(
+        lambda leaf: P(axis, *([None] * (leaf.ndim - 1))),
+        stack.parameter_tree())
+
+
+def gpipe_apply(stack: PipelineStack, local_params, x,
+                n_micro: int, axis_name: str = PIPELINE_AXIS,
+                training: bool = False):
+    """GPipe forward INSIDE shard_map.
+
+    local_params: this stage's slice, leaves (depth/P, ...).
+    x: full batch (replicated over the pipe axis); batch size must divide
+    by ``n_micro``. Returns the model output, replicated over the axis.
+    """
+    p = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b = x.shape[0]
+    assert b % n_micro == 0, f"batch {b} must divide into {n_micro} microbatches"
+    mbs = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+
+    def stage_fn(h):
+        return stack.scan_apply(local_params, h, training=training)
+
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    state = jnp.zeros_like(mbs[0])
+    state = lax.pcast(state, (axis_name,), to="varying")
+    out_buf = lax.pcast(jnp.zeros_like(mbs), (axis_name,), to="varying")
+    is_first = (idx == 0)
+    is_last = (idx == p - 1)
+
+    for t in range(n_micro + p - 1):
+        feed = mbs[min(t, n_micro - 1)]
+        inp = jnp.where(is_first & (t < n_micro), feed, state)
+        out = stage_fn(inp)
+        w = t - (p - 1)
+        if w >= 0:
+            upd = lax.dynamic_update_index_in_dim(out_buf, out, w, 0)
+            out_buf = jnp.where(is_last, upd, out_buf)
+        state = lax.ppermute(out, axis_name, perm)
+
+    # Only the last stage holds real outputs; psum replicates them (its
+    # transpose broadcasts the output cotangent back to the last stage).
+    out_buf = lax.psum(out_buf, axis_name)
+    return out_buf.reshape(b, *out_buf.shape[2:])
+
+
+def gpipe_loss_fn(stack: PipelineStack, criterion, mesh,
+                  n_micro: int, axis_name: str = PIPELINE_AXIS,
+                  head: Optional[Callable] = None):
+    """(stacked_params, head_params, x, labels) -> scalar loss, jittable.
+
+    Wraps the schedule in shard_map over ``mesh``; ``head`` is an optional
+    pure fn (head_params, features) -> logits applied after the stack
+    (replicated — run it on every stage; it is tiny relative to the stack).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    p_specs = pipeline_spec_tree(stack, axis_name)
+
+    def local_fn(stacked, head_params, x, labels):
+        feats = gpipe_apply(stack, stacked, x, n_micro, axis_name,
+                            training=True)
+        logits = head(head_params, feats) if head is not None else feats
+        loss = criterion.apply(logits, labels).astype(jnp.float32)
+        return loss
+
+    fn = shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(p_specs, P(), P(), P()),
+        out_specs=P(),
+        check_vma=False)
+    return fn

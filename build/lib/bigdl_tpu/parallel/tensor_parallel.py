@@ -1,0 +1,133 @@
+"""Tensor (model) parallelism as parameter sharding rules.
+
+New capability — the reference has none (SURVEY §2.5: "Tensor parallelism:
+ABSENT"). The TPU-native design is NOT manual collective placement: each
+parameter leaf gets a ``PartitionSpec`` over the mesh ``tensor`` axis and
+GSPMD inserts the all-gathers/reduce-scatters (the scaling-book recipe:
+pick a mesh, annotate shardings, let XLA place collectives on ICI).
+
+The rules encode the Megatron pattern:
+
+- **column-parallel Linear** — weight (out, in) sharded on ``out``; the
+  matmul's output activation comes out sharded on features, no comm.
+- **row-parallel Linear** — weight sharded on ``in``; XLA inserts one psum
+  over the partial products. Column→row pairs (FFN up/down, attention
+  qkv/out) therefore cost exactly one all-reduce each, the Megatron layout.
+- **MultiHeadAttention** — fused qkv (3E, E) column-sharded (head split),
+  out-proj row-sharded.
+- **LookupTable** — embedding dim sharded.
+- **SpatialConvolution** — output channels sharded.
+- everything else (norms, biases-of-row-layers, scalars) replicated.
+
+Usage: automatic for known layer types via ``infer_param_specs(model)``;
+override per-module with ``module.tp_mode = "column" | "row" | "replicate"``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from bigdl_tpu.parallel.mesh import TENSOR_AXIS
+
+COLUMN, ROW, REPLICATE = "column", "row", "replicate"
+
+
+def _linear_specs(mode: Optional[str], axis: str) -> Dict[str, P]:
+    if mode == COLUMN:
+        return {"weight": P(axis, None), "bias": P(axis)}
+    if mode == ROW:
+        # Bias replicated: it is added after the partial-product psum.
+        return {"weight": P(None, axis), "bias": P()}
+    return {}
+
+
+def _module_specs(module, axis: str) -> Dict[str, P]:
+    """Specs for the module's OWN parameters (not children)."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.parallel.expert import MoE, expert_param_specs
+
+    mode = getattr(module, "tp_mode", None)
+    if mode == REPLICATE:
+        return {}
+    if isinstance(module, MoE):
+        return expert_param_specs(module)
+    if isinstance(module, nn.Linear):
+        return _linear_specs(mode, axis)
+    if isinstance(module, nn.MultiHeadAttention):
+        return {"in_proj_weight": P(axis, None), "in_proj_bias": P(axis),
+                "out_proj_weight": P(None, axis), "out_proj_bias": P()}
+    if isinstance(module, nn.LookupTable):
+        return {"weight": P(None, axis)}
+    if isinstance(module, (nn.SpatialConvolution, nn.SpatialShareConvolution)):
+        # HWIO weight layout: shard output channels.
+        return {"weight": P(None, None, None, axis), "bias": P(axis)}
+    return {}
+
+
+def _tag_children(module) -> None:
+    """Auto-tag the Megatron column→row pairs inside known blocks."""
+    from bigdl_tpu import nn
+    if isinstance(module, nn.TransformerEncoderLayer):
+        if not hasattr(module.linear1, "tp_mode"):
+            module.linear1.tp_mode = COLUMN
+        if not hasattr(module.linear2, "tp_mode"):
+            module.linear2.tp_mode = ROW
+
+
+def infer_param_specs(model, axis: str = TENSOR_AXIS,
+                      axis_size=None) -> Any:
+    """Pytree of PartitionSpec matching ``model.parameter_tree()``.
+
+    ``axis_size``: when given, a would-be sharded dimension not divisible by
+    it falls back to replicated (GSPMD would otherwise pad-and-mask with
+    uneven shards; explicit replication is cheaper and predictable). Either
+    an int (applies to every named axis) or a dict {axis_name: size} — pass
+    ``dict(mesh.shape)`` to validate mixed tensor/expert specs.
+    """
+    _tag_children(model)
+
+    def divisible(spec: P, shape) -> bool:
+        if axis_size is None:
+            return True
+        for dim, name in enumerate(spec):
+            if name is None:
+                continue
+            size = (axis_size.get(name) if isinstance(axis_size, dict)
+                    else axis_size)
+            if size is None:
+                return False  # axis absent from the mesh → replicate
+            if size and shape[dim] % size != 0:
+                return False
+        return True
+
+    specs = {}
+    own = _module_specs(model, axis)
+    for name, value in model._parameters.items():
+        spec = own.get(name, P())
+        if spec != P() and not divisible(spec, np.shape(value)):
+            spec = P()
+        specs[name] = spec
+    for name, child in model._modules.items():
+        sub = infer_param_specs(child, axis, axis_size)
+        if sub:
+            specs[name] = sub
+    return specs
+
+
+def opt_state_specs(state_template, params_template, param_specs) -> Any:
+    """Specs for an OptimMethod state dict: any top-level entry whose tree
+    structure mirrors the params (velocity, m, v, ...) inherits the param
+    specs; scalars and counters stay replicated."""
+    import jax
+
+    p_struct = jax.tree_util.tree_structure(params_template)
+    out = {}
+    for key, val in state_template.items():
+        if jax.tree_util.tree_structure(val) == p_struct:
+            out[key] = param_specs
+        else:
+            out[key] = jax.tree_util.tree_map(lambda _: P(), val)
+    return out

@@ -1,0 +1,21 @@
+"""Torch-semantics tensor façade (reference ``$B/tensor/``: ``Tensor.scala:35``,
+``TensorMath.scala:28``, ``Storage.scala:27``, ``DenseTensor.scala:30``).
+
+The reference's tensor core is a mutable strided JVM array whose math
+dispatches to MKL JNI. On TPU the honest equivalent is **not** a strided
+buffer — XLA owns layout — so this façade keeps the reference's *API*
+(1-based ``select``/``narrow``/``transpose``, in-place ``fill``/``copy``/
+``add_``-style mutation, ``storage()`` access) while the data lives in a
+``jax.Array`` that is swapped wholesale on mutation. Compute-path code
+(``bigdl_tpu.nn``) works on raw ``jax.Array``s; this class is the
+user-facing / interop surface for code written against Torch-style tensors.
+
+Dispatch note (reference ``TensorNumeric.scala:37``, the MKL boundary):
+every op here lowers through jnp → XLA → MXU/VPU; there is no scalar
+fallback path because XLA compiles both the "MKL" and the "plain loop" case
+the same way.
+"""
+
+from bigdl_tpu.tensor.tensor import Storage, Tensor
+
+__all__ = ["Tensor", "Storage"]

@@ -1,0 +1,484 @@
+"""The Tensor/Storage façade (reference ``tensor/Tensor.scala:35`` API,
+``tensor/TensorMath.scala:28`` math surface, ``tensor/Storage.scala:27``).
+
+Semantics contract:
+- dimension and index arguments are **1-based** (Torch convention), as in
+  the reference API; negative values are not supported (matching it).
+- mutating methods (``fill``, ``zero``, ``copy``, ``add``, ``mul`` …) mutate
+  *this* tensor in the API sense and return ``self`` — underneath, the
+  backing ``jax.Array`` is replaced functionally.
+- views (``select``/``narrow``/``view``/``t``/``transpose``) return NEW
+  tensors that do NOT alias (XLA arrays are immutable — the reference's
+  shared-storage aliasing is an implementation detail its API never
+  guarantees for correctness, only for performance).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+Scalar = Union[int, float]
+
+
+class Storage:
+    """1-D view of a tensor's elements (reference ``Storage.scala:27``,
+    ``ArrayStorage.scala:22``)."""
+
+    def __init__(self, data: np.ndarray):
+        # always a host copy — jax arrays surface as read-only numpy views
+        self._data = np.array(data).ravel()
+
+    def __len__(self) -> int:
+        return self._data.size
+
+    def _check(self, i: int) -> int:
+        if not 1 <= i <= self._data.size:
+            raise IndexError(f"storage index {i} out of range "
+                             f"[1, {self._data.size}] (1-based)")
+        return i - 1
+
+    def __getitem__(self, i: int) -> Scalar:
+        return self._data[self._check(i)]  # 1-based, as the reference
+
+    def __setitem__(self, i: int, v: Scalar) -> None:
+        self._data[self._check(i)] = v
+
+    def array(self) -> np.ndarray:
+        return self._data
+
+    def __iter__(self):
+        return iter(self._data)
+
+
+def _promote(value) -> jnp.ndarray:
+    if isinstance(value, Tensor):
+        return value.data
+    return jnp.asarray(value)
+
+
+class Tensor:
+    """N-d tensor with the reference's Torch-style API
+    (reference ``Tensor.scala:35``; math mix-in ``TensorMath.scala:28``)."""
+
+    __array_priority__ = 100  # numpy defers to our __r*__ ops
+
+    def __init__(self, *args, dtype=None):
+        if len(args) == 1 and isinstance(args[0], (np.ndarray, jnp.ndarray)):
+            # array input: PRESERVE its dtype (int index tensors, float64,
+            # bf16 must survive clone/view/operator round-trips)
+            self.data = jnp.asarray(args[0], dtype=dtype)
+        elif len(args) == 1 and isinstance(args[0], (list, tuple)):
+            self.data = jnp.asarray(args[0], dtype=dtype or jnp.float32)
+        elif len(args) == 1 and isinstance(args[0], Tensor):
+            self.data = args[0].data
+        elif args:
+            if not all(isinstance(a, (int, np.integer)) for a in args):
+                raise TypeError(f"bad Tensor(...) arguments {args!r}")
+            self.data = jnp.zeros(tuple(int(a) for a in args),
+                                  dtype=dtype or jnp.float32)
+        else:
+            self.data = jnp.zeros((0,), dtype=dtype or jnp.float32)
+
+    # ------------------------------------------------------------ structure
+    def dim(self) -> int:
+        return self.data.ndim
+
+    n_dimension = dim
+
+    def size(self, dim: Optional[int] = None):
+        """size() → tuple; size(d) → int, d 1-based (``Tensor.scala``)."""
+        if dim is None:
+            return tuple(self.data.shape)
+        return self.data.shape[self._dim(dim)]
+
+    def n_element(self) -> int:
+        return int(self.data.size)
+
+    def _dim(self, d: int) -> int:
+        if not 1 <= d <= max(1, self.data.ndim):
+            raise IndexError(f"dimension {d} out of range for "
+                             f"{self.data.ndim}-d tensor (1-based)")
+        return d - 1
+
+    @staticmethod
+    def _index(i: int, size: int, what: str = "index") -> int:
+        """Validate a 1-based index — Torch raises on 0/out-of-range; jnp
+        would silently clip/wrap, corrupting results."""
+        if not 1 <= i <= size:
+            raise IndexError(f"{what} {i} out of range [1, {size}] (1-based)")
+        return i - 1
+
+    def is_same_size_as(self, other: "Tensor") -> bool:
+        return self.data.shape == other.data.shape
+
+    def is_contiguous(self) -> bool:
+        return True  # XLA arrays: always logically contiguous
+
+    def contiguous(self) -> "Tensor":
+        return self
+
+    # ------------------------------------------------------------- indexing
+    def select(self, dim: int, index: int) -> "Tensor":
+        """Drop ``dim`` at 1-based ``index`` (reference ``select``)."""
+        ax = self._dim(dim)
+        return Tensor(jnp.take(
+            self.data, self._index(index, self.data.shape[ax]), axis=ax))
+
+    def narrow(self, dim: int, index: int, size: int) -> "Tensor":
+        """Slice [index, index+size) on ``dim`` (1-based)."""
+        ax = self._dim(dim)
+        start = self._index(index, self.data.shape[ax])
+        if start + size > self.data.shape[ax]:
+            raise IndexError(f"narrow({dim},{index},{size}) exceeds size "
+                             f"{self.data.shape[ax]}")
+        sl = [slice(None)] * self.data.ndim
+        sl[ax] = slice(start, start + size)
+        return Tensor(self.data[tuple(sl)])
+
+    def view(self, *sizes: int) -> "Tensor":
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        return Tensor(jnp.reshape(self.data, sizes))
+
+    reshape = view
+
+    def transpose(self, dim1: int, dim2: int) -> "Tensor":
+        return Tensor(jnp.swapaxes(self.data, self._dim(dim1),
+                                   self._dim(dim2)))
+
+    def t(self) -> "Tensor":
+        if self.data.ndim != 2:
+            raise ValueError("t() expects a 2-d tensor")
+        return Tensor(self.data.T)
+
+    def squeeze(self, dim: Optional[int] = None) -> "Tensor":
+        if dim is None:
+            return Tensor(jnp.squeeze(self.data))
+        ax = self._dim(dim)
+        if self.data.shape[ax] != 1:
+            return Tensor(self.data)
+        return Tensor(jnp.squeeze(self.data, axis=ax))
+
+    def unsqueeze(self, dim: int) -> "Tensor":
+        return Tensor(jnp.expand_dims(self.data, dim - 1))
+
+    def expand(self, *sizes: int) -> "Tensor":
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        return Tensor(jnp.broadcast_to(self.data, sizes))
+
+    def repeat_tensor(self, *sizes: int) -> "Tensor":
+        return Tensor(jnp.tile(self.data, sizes))
+
+    def index_select(self, dim: int, indices) -> "Tensor":
+        ax = self._dim(dim)
+        idx = np.asarray(_promote(indices)).astype(np.int64)
+        if idx.size and (idx.min() < 1 or idx.max() > self.data.shape[ax]):
+            raise IndexError(f"index_select indices out of range "
+                             f"[1, {self.data.shape[ax]}] (1-based)")
+        return Tensor(jnp.take(self.data, jnp.asarray(idx - 1), axis=ax))
+
+    def masked_select(self, mask) -> "Tensor":
+        m = np.asarray(_promote(mask)).astype(bool)
+        return Tensor(np.asarray(self.data)[m])
+
+    def __getitem__(self, idx):
+        """1-based scalar/select indexing like the reference's ``apply``."""
+        if isinstance(idx, int):
+            if self.data.ndim == 1:
+                return float(self.data[self._index(idx, self.data.shape[0])])
+            return self.select(1, idx)
+        if isinstance(idx, tuple) and all(isinstance(i, int) for i in idx):
+            zero_based = tuple(self._index(i, s) for i, s in
+                               zip(idx, self.data.shape))
+            return float(self.data[zero_based])
+        raise TypeError("Tensor indexing is 1-based ints (Torch apply "
+                        "semantics); use .data for numpy-style slicing")
+
+    def set_value(self, *args) -> "Tensor":
+        *idx, value = args
+        zero_based = tuple(self._index(i, s) for i, s in
+                           zip(idx, self.data.shape))
+        self.data = self.data.at[zero_based].set(value)
+        return self
+
+    # ------------------------------------------------------------- mutation
+    def fill(self, value: Scalar) -> "Tensor":
+        self.data = jnp.full_like(self.data, value)
+        return self
+
+    def zero(self) -> "Tensor":
+        return self.fill(0)
+
+    def copy(self, other: "Tensor") -> "Tensor":
+        src = _promote(other)
+        if src.size != self.data.size:
+            raise ValueError(f"copy size mismatch {src.size} vs "
+                             f"{self.data.size}")
+        self.data = jnp.reshape(src, self.data.shape).astype(self.data.dtype)
+        return self
+
+    def resize(self, *sizes: int) -> "Tensor":
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        n_new = int(np.prod(sizes))
+        flat = jnp.ravel(self.data)
+        if n_new <= flat.size:
+            flat = flat[:n_new]
+        else:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros(n_new - flat.size, self.data.dtype)])
+        self.data = jnp.reshape(flat, sizes)
+        return self
+
+    resize_as = lambda self, other: self.resize(*other.size())
+
+    def apply1(self, fn: Callable[[float], float]) -> "Tensor":
+        """Elementwise python fn (reference ``apply1``) — host roundtrip;
+        for compiled elementwise math use the jnp-backed ops instead."""
+        host = np.asarray(self.data)
+        self.data = jnp.asarray(np.vectorize(fn)(host), self.data.dtype)
+        return self
+
+    # ----------------------------------------------------------------- math
+    def _binary(self, other, fn) -> "Tensor":
+        self.data = fn(self.data, _promote(other)).astype(self.data.dtype)
+        return self
+
+    def add(self, *args) -> "Tensor":
+        """add(value) | add(tensor) | add(scalar, tensor) — in-place,
+        reference ``TensorMath.add``."""
+        if len(args) == 1:
+            return self._binary(args[0], jnp.add)
+        scalar, tensor = args
+        self.data = self.data + scalar * _promote(tensor)
+        return self
+
+    def sub(self, *args) -> "Tensor":
+        if len(args) == 1:
+            return self._binary(args[0], jnp.subtract)
+        scalar, tensor = args
+        self.data = self.data - scalar * _promote(tensor)
+        return self
+
+    def mul(self, other) -> "Tensor":
+        return self._binary(other, jnp.multiply)
+
+    def div(self, other) -> "Tensor":
+        return self._binary(other, jnp.divide)
+
+    def cmul(self, other) -> "Tensor":
+        return self._binary(other, jnp.multiply)
+
+    def cdiv(self, other) -> "Tensor":
+        return self._binary(other, jnp.divide)
+
+    def cadd(self, scalar, other) -> "Tensor":
+        return self.add(scalar, other)
+
+    def pow(self, exponent: Scalar) -> "Tensor":
+        self.data = jnp.power(self.data, exponent)
+        return self
+
+    def sqrt(self) -> "Tensor":
+        self.data = jnp.sqrt(self.data)
+        return self
+
+    def abs(self) -> "Tensor":
+        self.data = jnp.abs(self.data)
+        return self
+
+    def log(self) -> "Tensor":
+        self.data = jnp.log(self.data)
+        return self
+
+    def log1p(self) -> "Tensor":
+        self.data = jnp.log1p(self.data)
+        return self
+
+    def exp(self) -> "Tensor":
+        self.data = jnp.exp(self.data)
+        return self
+
+    # non-mutating reductions / products
+    def sum(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.sum(self.data))
+        return Tensor(jnp.sum(self.data, axis=self._dim(dim), keepdims=True))
+
+    def mean(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.mean(self.data))
+        return Tensor(jnp.mean(self.data, axis=self._dim(dim), keepdims=True))
+
+    def max(self, dim: Optional[int] = None):
+        """max() → scalar; max(d) → (values, 1-based indices) like Torch."""
+        if dim is None:
+            return float(jnp.max(self.data))
+        ax = self._dim(dim)
+        values = jnp.max(self.data, axis=ax, keepdims=True)
+        indices = jnp.expand_dims(jnp.argmax(self.data, axis=ax) + 1, ax)
+        return Tensor(values), Tensor(indices.astype(jnp.int32))
+
+    def min(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.min(self.data))
+        ax = self._dim(dim)
+        values = jnp.min(self.data, axis=ax, keepdims=True)
+        indices = jnp.expand_dims(jnp.argmin(self.data, axis=ax) + 1, ax)
+        return Tensor(values), Tensor(indices.astype(jnp.int32))
+
+    def norm(self, p: Scalar = 2) -> float:
+        if p == 1:
+            return float(jnp.sum(jnp.abs(self.data)))
+        return float(jnp.sum(jnp.abs(self.data) ** p) ** (1.0 / p))
+
+    def dot(self, other: "Tensor") -> float:
+        return float(jnp.vdot(self.data, _promote(other)))
+
+    def mm(self, a: "Tensor", b: "Tensor") -> "Tensor":
+        """self = a @ b (reference ``mm`` writes into the receiver)."""
+        self.data = jnp.matmul(_promote(a), _promote(b))
+        return self
+
+    def mv(self, a: "Tensor", x: "Tensor") -> "Tensor":
+        self.data = jnp.matmul(_promote(a), _promote(x))
+        return self
+
+    def addmm(self, *args) -> "Tensor":
+        """addmm([beta,] [M,] [alpha,] mat1, mat2): β·M + α·mat1@mat2
+        (reference ``TensorMath.addmm`` overload family). Overloads are
+        resolved by scalar-vs-tensor TYPE, not just arity — a leading scalar
+        is β, a leading tensor is M."""
+        beta, alpha, m = 1.0, 1.0, self
+        rest = list(args)
+
+        def is_scalar(x):
+            return isinstance(x, (int, float, np.floating, np.integer))
+
+        mat1, mat2 = rest[-2], rest[-1]
+        head = rest[:-2]
+        if head and is_scalar(head[0]):
+            beta = head.pop(0)
+        if head and not is_scalar(head[0]):
+            m = head.pop(0)
+        if head and is_scalar(head[0]):
+            alpha = head.pop(0)
+        if head:
+            raise TypeError(f"unsupported addmm argument shape {args!r}")
+        self.data = (beta * _promote(m)
+                     + alpha * jnp.matmul(_promote(mat1), _promote(mat2)))
+        return self
+
+    def addmv(self, beta: Scalar, alpha: Scalar, mat, vec) -> "Tensor":
+        self.data = beta * self.data + alpha * jnp.matmul(
+            _promote(mat), _promote(vec))
+        return self
+
+    def addr(self, alpha: Scalar, vec1, vec2) -> "Tensor":
+        self.data = self.data + alpha * jnp.outer(_promote(vec1),
+                                                  _promote(vec2))
+        return self
+
+    # ------------------------------------------------------------ operators
+    def __add__(self, other):
+        return Tensor(self.data + _promote(other))
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return Tensor(self.data - _promote(other))
+
+    def __rsub__(self, other):
+        return Tensor(_promote(other) - self.data)
+
+    def __mul__(self, other):
+        return Tensor(self.data * _promote(other))
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return Tensor(self.data / _promote(other))
+
+    def __neg__(self):
+        return Tensor(-self.data)
+
+    def __eq__(self, other):
+        if isinstance(other, Tensor):
+            return (self.data.shape == other.data.shape
+                    and bool(jnp.all(self.data == other.data)))
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+    def almost_equal(self, other: "Tensor", tol: float = 1e-6) -> bool:
+        return (self.data.shape == _promote(other).shape
+                and bool(jnp.all(jnp.abs(self.data - _promote(other)) <= tol)))
+
+    # ---------------------------------------------------------------- misc
+    def clone(self) -> "Tensor":
+        return Tensor(self.data)
+
+    def storage(self) -> Storage:
+        """Host-side element view (reference ``storage()``). Mutations to the
+        returned Storage are NOT reflected back (XLA arrays are immutable);
+        call ``set_storage`` to write it back."""
+        return Storage(np.asarray(self.data))
+
+    def set_storage(self, storage: Storage) -> "Tensor":
+        self.data = jnp.reshape(jnp.asarray(storage.array()),
+                                self.data.shape).astype(self.data.dtype)
+        return self
+
+    def to_jax(self) -> jnp.ndarray:
+        return self.data
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.data)
+
+    def rand(self) -> "Tensor":
+        from bigdl_tpu.utils.rng import RandomGenerator
+        self.data = jnp.asarray(
+            RandomGenerator.RNG().uniform(0, 1, self.data.shape),
+            self.data.dtype)
+        return self
+
+    def randn(self) -> "Tensor":
+        from bigdl_tpu.utils.rng import RandomGenerator
+        self.data = jnp.asarray(
+            RandomGenerator.RNG().normal(0, 1, self.data.shape),
+            self.data.dtype)
+        return self
+
+    def bernoulli(self, p: float) -> "Tensor":
+        from bigdl_tpu.utils.rng import RandomGenerator
+        self.data = jnp.asarray(
+            RandomGenerator.RNG().bernoulli(p, self.data.shape),
+            self.data.dtype)
+        return self
+
+    def __repr__(self) -> str:
+        return (f"Tensor(size={tuple(self.data.shape)}, "
+                f"dtype={self.data.dtype})\n{np.asarray(self.data)}")
+
+    # ---------------------------------------------------------- conversions
+    @staticmethod
+    def from_numpy(arr: np.ndarray) -> "Tensor":
+        return Tensor(jnp.asarray(arr))
+
+    @staticmethod
+    def range(start: Scalar, stop: Scalar, step: Scalar = 1) -> "Tensor":
+        """Inclusive range like Torch's ``Tensor.range``."""
+        return Tensor(jnp.arange(start, stop + step * 0.5, step))
+
+    @staticmethod
+    def ones(*sizes: int) -> "Tensor":
+        return Tensor(jnp.ones(sizes, jnp.float32))
+
+    @staticmethod
+    def zeros(*sizes: int) -> "Tensor":
+        return Tensor(jnp.zeros(sizes, jnp.float32))

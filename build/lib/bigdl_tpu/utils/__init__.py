@@ -1,0 +1,9 @@
+"""bigdl_tpu.utils — engine, tables, RNG, file IO (reference ``$B/utils/``)."""
+
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils.rng import RandomGenerator, manual_seed
+from bigdl_tpu.utils import file_io
+from bigdl_tpu.utils.file_io import save, load
+from bigdl_tpu.utils.util import kth_largest
+from bigdl_tpu.utils.logger_filter import redirect_logs

@@ -1,0 +1,64 @@
+"""Checkpoint file IO (reference ``utils/File.scala`` — java serialization
+with local/HDFS URIs).
+
+TPU-native rebuild: pytrees of device arrays are pulled to host numpy and
+written with a small self-describing pickle envelope. Local filesystem and
+``file://`` URIs supported; remote stores can be layered by registering a
+scheme handler (the reference's HDFS support becomes a pluggable hook —
+GCS/S3 clients aren't available in this environment).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Dict
+
+import jax
+import numpy as np
+
+_MAGIC = b"BIGDL_TPU_V1"
+_SCHEME_HANDLERS: Dict[str, Any] = {}
+
+
+def register_scheme(scheme: str, opener: Callable[[str, str], Any]) -> None:
+    """Register an ``opener(path, mode) -> file`` for a URI scheme."""
+    _SCHEME_HANDLERS[scheme] = opener
+
+
+def _open(path: str, mode: str):
+    if "://" in path:
+        scheme, rest = path.split("://", 1)
+        if scheme == "file":
+            path = rest
+        elif scheme in _SCHEME_HANDLERS:
+            return _SCHEME_HANDLERS[scheme](rest, mode)
+        else:
+            raise ValueError(f"no handler registered for scheme {scheme!r}")
+    if "w" in mode:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+    return open(path, mode)
+
+
+def _to_host(obj: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, (jax.Array, np.ndarray)) else x, obj)
+
+
+def save(obj: Any, path: str, overwrite: bool = True) -> None:
+    """Serialize a pytree/Table/object (reference ``File.save``)."""
+    if not overwrite and os.path.exists(path):
+        raise FileExistsError(path)
+    with _open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_to_host(obj), f, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load(path: str) -> Any:
+    """Deserialize (reference ``File.load``)."""
+    with _open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError(f"{path} is not a bigdl_tpu checkpoint")
+        return pickle.load(f)

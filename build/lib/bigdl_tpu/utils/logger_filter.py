@@ -1,0 +1,61 @@
+"""Logging policy (reference ``utils/LoggerFilter.scala:28``): keep
+``bigdl_tpu.optim`` progress on the console, route chatty runtime/library
+INFO (jax, absl, the reference's spark/akka/breeze equivalents) to a file.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+_DEFAULT_NOISY = ("jax", "absl", "orbax", "flax")
+_configured_path: Optional[str] = None
+
+
+def redirect_logs(log_file: Optional[str] = None,
+                  noisy: Sequence[str] = _DEFAULT_NOISY,
+                  console_level: int = logging.INFO) -> None:
+    """Reference ``LoggerFilter.redirectSparkInfoLogs``: library INFO chatter
+    goes to ``bigdl.log`` under $BIGDL_LOG_DIR (default: the system temp dir,
+    NOT the cwd — app mains must not litter the caller's directory);
+    bigdl_tpu progress logs stay on the console. Re-invoking with the same
+    (or no) target is a no-op; a different ``log_file`` re-routes."""
+    global _configured_path
+    import tempfile
+    log_path = log_file or os.path.join(
+        os.environ.get("BIGDL_LOG_DIR", tempfile.gettempdir()), "bigdl.log")
+    if _configured_path is not None and _configured_path == log_path:
+        return
+    _configured_path = log_path
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s", "%H:%M:%S")
+
+    try:
+        file_handler: Optional[logging.Handler] = logging.FileHandler(log_path)
+        file_handler.setFormatter(fmt)
+    except OSError:
+        file_handler = None  # read-only cwd: keep chatter suppressed instead
+
+    for name in noisy:
+        lg = logging.getLogger(name)
+        for h in lg.handlers:  # close replaced handlers (re-route support)
+            try:
+                h.close()
+            except Exception:
+                pass
+        lg.handlers = [file_handler] if file_handler else []
+        lg.propagate = False
+        lg.setLevel(logging.INFO)
+
+    bt = logging.getLogger("bigdl_tpu")
+    if not bt.handlers:
+        console = logging.StreamHandler()
+        console.setFormatter(fmt)
+        bt.addHandler(console)
+    bt.setLevel(console_level)
+
+
+def reset() -> None:
+    global _configured_path
+    _configured_path = None

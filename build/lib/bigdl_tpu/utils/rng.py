@@ -1,0 +1,89 @@
+"""RandomGenerator: seedable RNG facade over ``jax.random``.
+
+Reference parity: ``utils/RandomGenerator.scala`` (a hand-written
+Mersenne-Twister with per-thread instances and uniform/normal/exponential/
+cauchy/logNormal/geometric/bernoulli draws). The TPU-native design replaces
+the stateful twister with JAX's splittable counter-based keys — the only RNG
+design that stays deterministic under SPMD compilation — while keeping the
+reference's *interface*: a process-global, seedable generator object.
+
+Inside ``jit``-traced module code, randomness must come from the RngStream
+bound by the functional-apply context (see ``nn/module.py``); this module is
+for host-side uses (shuffles, init, data augmentation).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class RandomGenerator:
+    """Per-thread seedable generator (reference ``RandomGenerator.RNG``)."""
+
+    _thread_local = threading.local()
+
+    def __init__(self, seed: int = 1):
+        self.set_seed(seed)
+
+    @classmethod
+    def RNG(cls) -> "RandomGenerator":
+        inst = getattr(cls._thread_local, "inst", None)
+        if inst is None:
+            inst = cls(seed=1)
+            cls._thread_local.inst = inst
+        return inst
+
+    def set_seed(self, seed: int) -> "RandomGenerator":
+        self._seed = int(seed)
+        self._np = np.random.default_rng(self._seed)
+        self._key = jax.random.key(self._seed)
+        return self
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    # -- key plumbing ---------------------------------------------------------
+    def next_key(self):
+        """Split off a fresh JAX PRNG key."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- host-side draws (numpy-backed; used by data pipeline / init) --------
+    def uniform(self, low: float = 0.0, high: float = 1.0, size=None):
+        return self._np.uniform(low, high, size)
+
+    def normal(self, mean: float = 0.0, stdv: float = 1.0, size=None):
+        return self._np.normal(mean, stdv, size)
+
+    def exponential(self, lam: float = 1.0, size=None):
+        return self._np.exponential(1.0 / lam, size)
+
+    def cauchy(self, median: float = 0.0, sigma: float = 1.0, size=None):
+        return median + sigma * self._np.standard_cauchy(size)
+
+    def log_normal(self, mean: float = 1.0, stdv: float = 2.0, size=None):
+        # Torch semantics: mean/stdv are of the underlying normal's exp.
+        var = stdv * stdv
+        mu = np.log(mean * mean / np.sqrt(var + mean * mean))
+        sigma = np.sqrt(np.log(var / (mean * mean) + 1.0))
+        return self._np.lognormal(mu, sigma, size)
+
+    def geometric(self, p: float = 0.5, size=None):
+        return self._np.geometric(p, size)
+
+    def bernoulli(self, p: float = 0.5, size=None):
+        return (self._np.random(size) < p).astype(np.float32)
+
+    def randperm(self, n: int) -> np.ndarray:
+        """1-based random permutation (Torch ``randperm`` semantics)."""
+        return self._np.permutation(n) + 1
+
+    def shuffle(self, arr) -> None:
+        self._np.shuffle(arr)
+
+
+def manual_seed(seed: int) -> None:
+    RandomGenerator.RNG().set_seed(seed)

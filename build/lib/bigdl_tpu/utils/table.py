@@ -1,0 +1,73 @@
+"""Lua-style Table: the universal heterogeneous state/config container.
+
+Reference parity: ``utils/Table.scala:34`` — an int/any-keyed map used as the
+optimizer "state", multi-tensor Activity, and hyper-parameter store. Here it is
+a thin dict subclass with 1-based integer convenience (Torch semantics) and the
+``T(...)`` builder. It is registered as a JAX pytree so Tables of arrays flow
+through ``jit``/``grad`` unchanged — that is the TPU-native twist: a Table of
+tensors is a legal traced value, so multi-input/multi-output modules need no
+special casing inside compiled programs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+import jax
+
+
+class Table(dict):
+    """Heterogeneous container keyed by ints (1-based) or strings."""
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        if len(args) == 1 and isinstance(args[0], dict):
+            super().__init__(args[0])
+        else:
+            super().__init__({i + 1: v for i, v in enumerate(args)})
+        self.update(kwargs)
+
+    # -- Torch-style accessors ------------------------------------------------
+    def insert(self, value: Any) -> "Table":
+        self[self.length() + 1] = value
+        return self
+
+    def length(self) -> int:
+        n = 0
+        while (n + 1) in self:
+            n += 1
+        return n
+
+    def __iter__(self) -> Iterator[Any]:
+        # Iterate positional entries in order, like a Lua array part.
+        for i in range(1, self.length() + 1):
+            yield self[i]
+
+    def get_or_else(self, key: Any, default: Any) -> Any:
+        return self.get(key, default)
+
+    def clone(self) -> "Table":
+        return Table(dict(self))
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        items = ", ".join(f"{k}: {v!r}" for k, v in self.items())
+        return f"T{{{items}}}"
+
+
+def T(*args: Any, **kwargs: Any) -> Table:
+    """Builder mirroring the reference's ``T(...)`` (``utils/Table.scala``)."""
+    return Table(*args, **kwargs)
+
+
+def _table_flatten(t: Table):
+    keys = sorted(t.keys(), key=lambda k: (str(type(k)), str(k)))
+    return [t[k] for k in keys], tuple(keys)
+
+
+def _table_unflatten(keys, values) -> Table:
+    t = Table()
+    for k, v in zip(keys, values):
+        t[k] = v
+    return t
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)

@@ -1,0 +1,21 @@
+"""Small utilities (reference ``utils/Util.scala:20``)."""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+
+def kth_largest(values, k: int) -> float:
+    """k-th largest element, k is 1-based (reference ``Util.kthLargest`` —
+    quickselect; used for the straggler-drop threshold). Native-backed."""
+    arr = np.ascontiguousarray(values, dtype=np.float64).ravel()
+    if not 1 <= k <= arr.size:
+        raise ValueError(f"k={k} out of range for {arr.size} values")
+    from bigdl_tpu import native
+    lib = native.load()
+    if lib is not None:
+        ptr = arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        return float(lib.bt_kth_largest(ptr, arr.size, k))
+    return float(np.partition(arr, arr.size - k)[arr.size - k])

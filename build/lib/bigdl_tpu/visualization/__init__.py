@@ -1,0 +1,33 @@
+"""TensorBoard-compatible observability (reference ``$B/visualization/``, 635 LoC).
+
+Like the reference, this package writes TensorBoard event files with ZERO
+TensorFlow dependency: the reference vendors protoc-generated Java classes
+(``org/tensorflow/{framework/Summary.java,util/Event.java}``) plus a CRC32C
+(``java/netty/Crc32c.java``); here the two tiny messages are hand-encoded on
+the protobuf wire format directly (`proto.py`) and CRC32C is table-driven
+Python with an optional C++ fast path (`bigdl_tpu.native`).
+
+Public surface mirrors the reference:
+
+- ``TrainSummary`` / ``ValidationSummary`` (``TrainSummary.scala:32``,
+  ``ValidationSummary.scala``) — named scalar/histogram logging with
+  per-tag triggers, consumed by the Optimizer hooks.
+- ``FileWriter`` (async thread, ``FileWriter.scala``), ``EventWriter``
+  (queue + flush interval, ``tensorboard/EventWriter.scala:31``),
+  ``RecordWriter`` (TFRecord framing + masked CRC32C,
+  ``tensorboard/RecordWriter.scala:29,45-50``).
+- ``FileReader`` readback used from the Python API
+  (``tensorboard/FileReader.scala``; ``Summary.readScalar``).
+"""
+
+from bigdl_tpu.visualization.summary import (
+    Summary, TrainSummary, ValidationSummary,
+)
+from bigdl_tpu.visualization.tensorboard import (
+    EventWriter, FileWriter, RecordWriter, FileReader,
+)
+
+__all__ = [
+    "Summary", "TrainSummary", "ValidationSummary",
+    "EventWriter", "FileWriter", "RecordWriter", "FileReader",
+]

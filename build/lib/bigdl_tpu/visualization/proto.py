@@ -1,0 +1,158 @@
+"""Hand-rolled protobuf wire encoding of the TF ``Event``/``Summary`` messages.
+
+The reference vendors 114 kLoC of protoc-generated Java for these formats
+(``spark/dl/src/main/java/org/tensorflow/{framework,util}/``); the messages
+actually used are tiny, so here they are encoded/decoded directly on the wire
+format. Field numbers follow tensorflow's ``event.proto`` / ``summary.proto``:
+
+    Event    { 1: wall_time (double), 2: step (int64),
+               3: file_version (string), 5: summary (Summary) }
+    Summary  { 1: repeated Value }
+    Value    { 1: tag (string), 2: simple_value (float),
+               5: histo (HistogramProto) }
+    HistogramProto { 1: min, 2: max, 3: num, 4: sum, 5: sum_squares (double),
+                     6: repeated bucket_limit (packed double),
+                     7: repeated bucket (packed double) }
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+_WT_VARINT = 0
+_WT_I64 = 1
+_WT_LEN = 2
+_WT_I32 = 5
+
+
+# ------------------------------------------------------------------ encoding
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wire_type: int) -> bytes:
+    return _varint((field << 3) | wire_type)
+
+
+def _double_field(field: int, value: float) -> bytes:
+    return _key(field, _WT_I64) + struct.pack("<d", value)
+
+
+def _float_field(field: int, value: float) -> bytes:
+    return _key(field, _WT_I32) + struct.pack("<f", value)
+
+
+def _varint_field(field: int, value: int) -> bytes:
+    return _key(field, _WT_VARINT) + _varint(value)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _key(field, _WT_LEN) + _varint(len(payload)) + payload
+
+
+def _packed_doubles(field: int, values) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in values)
+    return _len_field(field, payload)
+
+
+def encode_scalar_value(tag: str, value: float) -> bytes:
+    return _len_field(1, tag.encode("utf-8")) + _float_field(2, value)
+
+
+def encode_histogram(values: np.ndarray) -> bytes:
+    """Encode a HistogramProto from raw values, TF-style exponential buckets."""
+    v = np.asarray(values, dtype=np.float64).ravel()
+    # NaNs appear exactly when training diverges — the histogram must still
+    # encode (observability is most needed then), so bucket only finite values
+    v = v[np.isfinite(v)]
+    if v.size == 0:
+        v = np.zeros((1,), dtype=np.float64)
+    limits = _bucket_limits()
+    counts = np.zeros(len(limits), dtype=np.float64)
+    idx = np.minimum(np.searchsorted(limits, v, side="left"), len(limits) - 1)
+    np.add.at(counts, idx, 1.0)
+    # trim empty tail/head buckets but keep one boundary bucket each side
+    nz = np.nonzero(counts)[0]
+    lo, hi = max(0, nz[0] - 1), min(len(limits) - 1, nz[-1] + 1)
+    msg = (_double_field(1, float(v.min())) + _double_field(2, float(v.max()))
+           + _double_field(3, float(v.size)) + _double_field(4, float(v.sum()))
+           + _double_field(5, float(np.square(v).sum()))
+           + _packed_doubles(6, limits[lo:hi + 1])
+           + _packed_doubles(7, counts[lo:hi + 1]))
+    return msg
+
+
+_BUCKET_LIMITS: Optional[np.ndarray] = None
+
+
+def _bucket_limits() -> np.ndarray:
+    global _BUCKET_LIMITS
+    if _BUCKET_LIMITS is None:
+        pos = []
+        x = 1e-12
+        while x < 1e20:
+            pos.append(x)
+            x *= 1.1
+        limits = [-x for x in reversed(pos)] + [0.0] + pos + [float("inf")]
+        _BUCKET_LIMITS = np.asarray(limits)
+    return _BUCKET_LIMITS
+
+
+def encode_histo_value(tag: str, values: np.ndarray) -> bytes:
+    return _len_field(1, tag.encode("utf-8")) + _len_field(5, encode_histogram(values))
+
+
+def encode_event(wall_time: float, step: Optional[int] = None,
+                 file_version: Optional[str] = None,
+                 summary_values: Optional[List[bytes]] = None) -> bytes:
+    msg = _double_field(1, wall_time)
+    if step is not None:
+        msg += _varint_field(2, step)
+    if file_version is not None:
+        msg += _len_field(3, file_version.encode("utf-8"))
+    if summary_values:
+        summary = b"".join(_len_field(1, v) for v in summary_values)
+        msg += _len_field(5, summary)
+    return msg
+
+
+# ------------------------------------------------------------------ decoding
+
+from bigdl_tpu.utils.protowire import iter_fields as _iter_fields  # noqa: E402
+
+
+def decode_event(buf: bytes) -> dict:
+    """Decode an Event into {wall_time, step, file_version, scalars:[(tag,val)]}."""
+    out = {"wall_time": 0.0, "step": 0, "file_version": None, "scalars": []}
+    for field, wt, val in _iter_fields(buf):
+        if field == 1 and wt == _WT_I64:
+            out["wall_time"] = struct.unpack("<d", val)[0]
+        elif field == 2 and wt == _WT_VARINT:
+            out["step"] = val
+        elif field == 3 and wt == _WT_LEN:
+            out["file_version"] = val.decode("utf-8", "replace")
+        elif field == 5 and wt == _WT_LEN:
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1 and w2 == _WT_LEN:
+                    tag, simple = None, None
+                    for f3, w3, v3 in _iter_fields(v2):
+                        if f3 == 1 and w3 == _WT_LEN:
+                            tag = v3.decode("utf-8", "replace")
+                        elif f3 == 2 and w3 == _WT_I32:
+                            simple = struct.unpack("<f", v3)[0]
+                    if tag is not None and simple is not None:
+                        out["scalars"].append((tag, simple))
+    return out

