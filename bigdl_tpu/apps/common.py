@@ -50,12 +50,15 @@ def test_parser(prog: str, default_batch: int = 128) -> argparse.ArgumentParser:
 
 def build_optimizer(model, train_set, criterion, args,
                     validation_set=None,
-                    methods=None) -> Optimizer:
+                    methods=None,
+                    optim_method=None) -> Optimizer:
     """The per-model ``Train.scala`` body: optimizer + schedules + triggers
-    + checkpoint + summaries, from parsed args."""
+    + checkpoint + summaries, from parsed args. ``optim_method`` overrides
+    the default SGD (e.g. textclassifier uses Adagrad, reference
+    ``example/textclassification/TextClassifier.scala:241``)."""
     redirect_logs()
     opt = Optimizer(model, train_set, criterion)
-    opt.set_optim_method(SGD(
+    opt.set_optim_method(optim_method or SGD(
         learningrate=args.learningRate,
         learningrate_decay=args.learningRateDecay,
         momentum=args.momentum,

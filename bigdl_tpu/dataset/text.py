@@ -10,6 +10,7 @@ with UNK, index (1-based) or one-hot sample encodings.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 from collections import Counter
@@ -156,3 +157,90 @@ class LabeledSentenceToSample(Transformer[LabeledSentence, Sample]):
             else:
                 feat = (data_idx + 1).astype(np.float32)
             yield Sample(feat, label)
+
+
+def load_glove_vectors(path: str, word2index,
+                       embedding_dim: int) -> np.ndarray:
+    """Read GloVe word vectors for a known vocabulary into an embedding
+    matrix (reference ``example/utils/TextClassifier.scala:56-70``
+    ``buildWord2Vec``: only vocabulary words are kept).
+
+    Returns ``(len(word2index) + 1, embedding_dim)`` float32 — row 0 is the
+    all-zero padding/UNK vector, row ``i+1`` the vector of the word with
+    index ``i``; words missing from the file stay zero.
+    """
+    mat = np.zeros((len(word2index) + 1, embedding_dim), np.float32)
+    found = 0
+    # official glove.6B files are UTF-8 (the reference reads ISO-8859-1,
+    # which garbles accented words into never-matching tokens)
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            values = line.rstrip().split(" ")
+            word = values[0]
+            idx = word2index.get(word)
+            if idx is None or len(values) != embedding_dim + 1:
+                continue
+            mat[idx + 1] = np.asarray(values[1:], np.float32)
+            found += 1
+    logging.getLogger(__name__).info("Found %d word vectors.", found)
+    return mat
+
+
+def load_category_folder(base_dir: str):
+    """Read a 20-newsgroup-style tree — one subdirectory per category, one
+    text file per document (reference ``TextClassifier.scala:96-121``
+    ``loadRawData``). Returns ``(texts, labels, class_num)`` with 1-based
+    labels assigned by sorted category name."""
+    texts, labels = [], []
+    categories = sorted(d for d in os.listdir(base_dir)
+                        if os.path.isdir(os.path.join(base_dir, d)))
+    for label, cat in enumerate(categories, start=1):
+        cat_dir = os.path.join(base_dir, cat)
+        for name in sorted(os.listdir(cat_dir)):
+            p = os.path.join(cat_dir, name)
+            if not os.path.isfile(p):
+                continue
+            with open(p, encoding="latin-1") as f:
+                texts.append(f.read())
+            labels.append(float(label))
+    return texts, labels, len(categories)
+
+
+class TokensToIndexedSample(Transformer[tuple, Sample]):
+    """(tokens, label) -> Sample((seq_len,) 1-based indices, label):
+    out-of-vocabulary tokens are dropped (reference filters tokens without a
+    word2Meta entry, ``TextClassifier.scala:140-169``), the rest truncated /
+    zero-padded to ``seq_len``. Index 0 is the padding row."""
+
+    def __init__(self, word2index, seq_len: int):
+        self.word2index = word2index
+        self.seq_len = seq_len
+
+    def __call__(self, prev: Iterator[tuple]) -> Iterator[Sample]:
+        for tokens, label in prev:
+            feat = np.zeros((self.seq_len,), np.float32)
+            t = 0
+            for tok in tokens:
+                if t == self.seq_len:
+                    break
+                idx = self.word2index.get(tok)
+                if idx is None:
+                    continue
+                feat[t] = idx + 1
+                t += 1
+            yield Sample(feat, np.float32(label))
+
+
+class IndexedToEmbeddedSample(Transformer[Sample, Sample]):
+    """Sample((T,) indices) -> Sample((T, embedding_dim)) by embedding-matrix
+    row lookup, applied lazily per iteration so the dataset stores ~4-byte
+    indices, not dense vectors (the reference pre-embeds the whole corpus up
+    front; at 20-newsgroup scale that is gigabytes of host RAM)."""
+
+    def __init__(self, embeddings: np.ndarray):
+        self.embeddings = np.asarray(embeddings, np.float32)
+
+    def __call__(self, prev: Iterator[Sample]) -> Iterator[Sample]:
+        for s in prev:
+            idx = np.asarray(s.feature, np.int64)
+            yield Sample(self.embeddings[idx], s.label)

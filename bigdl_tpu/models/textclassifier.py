@@ -1,0 +1,52 @@
+"""GloVe+CNN text classifier (reference
+``example/utils/TextClassifier.scala:171-196`` ``buildModel``).
+
+The reference reshapes pre-embedded sentences to ``(embeddingDim, 1, seqLen)``
+(NCHW) and convolves over the sequence with three conv/pool stages. Here the
+TPU-native layout is channels-last: input ``(N, T, E)`` is viewed as NHWC
+``(N, T, 1, E)`` with time as the spatial H axis, so every conv lands on the
+MXU with the embedding dim as the contracted channel axis.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def conv_output_length(sequence_length: int) -> int:
+    """Time extent left after the reference's conv5/pool5 x2 + conv5 stages."""
+    h = sequence_length - 4      # conv k=5
+    h = h // 5                   # pool k=5 s=5
+    h = h - 4                    # conv k=5
+    h = h // 5                   # pool k=5 s=5
+    h = h - 4                    # conv k=5
+    return h
+
+
+def build_cnn(class_num: int, sequence_length: int = 1000,
+              embedding_dim: int = 100) -> nn.Sequential:
+    """Reference geometry (seq 1000 -> final 35-wide pool -> 1): input
+    ``(N, sequence_length, embedding_dim)`` pre-embedded tokens, output
+    ``(N, class_num)`` log-probs. The final pool is sized to whatever time
+    extent remains so shorter sequence lengths (tests) also collapse to 1."""
+    last = conv_output_length(sequence_length)
+    if last < 1:
+        raise ValueError(
+            f"sequence_length {sequence_length} too short for the "
+            f"conv5/pool5 x3 stack (needs >= 149)")
+    return (nn.Sequential()
+            .add(nn.Reshape((sequence_length, 1, embedding_dim),
+                            batch_mode=True))
+            .add(nn.SpatialConvolution(embedding_dim, 128, 1, 5))
+            .add(nn.ReLU())
+            .add(nn.SpatialMaxPooling(1, 5))
+            .add(nn.SpatialConvolution(128, 128, 1, 5))
+            .add(nn.ReLU())
+            .add(nn.SpatialMaxPooling(1, 5))
+            .add(nn.SpatialConvolution(128, 128, 1, 5))
+            .add(nn.ReLU())
+            .add(nn.SpatialMaxPooling(1, last))
+            .add(nn.Reshape((128,), batch_mode=True))
+            .add(nn.Linear(128, 100))
+            .add(nn.Linear(100, class_num))
+            .add(nn.LogSoftMax()))

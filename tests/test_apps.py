@@ -7,7 +7,8 @@ import os
 import numpy as np
 import pytest
 
-from bigdl_tpu.apps import autoencoder, lenet, perf, resnet, rnn, vgg
+from bigdl_tpu.apps import (autoencoder, lenet, perf, resnet, rnn,
+                            textclassifier, vgg)
 
 
 class TestTrainMains:
@@ -35,6 +36,33 @@ class TestTrainMains:
 
     def test_autoencoder_train(self):
         autoencoder.train(["-b", "32", "-e", "1", "--synthetic-size", "64"])
+
+    def test_textclassifier_train(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        textclassifier.train(["-b", "16", "-e", "1", "--synthetic-size", "64",
+                              "--maxSequenceLength", "150",
+                              "--embeddingDim", "20", "--checkpoint", ck])
+        assert os.path.exists(os.path.join(ck, "model_final"))
+
+    def test_textclassifier_real_folder_layout(self, tmp_path):
+        # 20_newsgroup-style tree + tiny GloVe file exercising the real path
+        base = tmp_path / "data"
+        for cat in ["alt.atheism", "sci.space"]:
+            d = base / "20_newsgroup" / cat
+            d.mkdir(parents=True)
+            for i in range(12):
+                word = "god" if cat == "alt.atheism" else "orbit"
+                (d / str(i)).write_text(f"the {word} text {word} here " * 30)
+        glove = base / "glove.6B"
+        glove.mkdir()
+        rng = np.random.RandomState(0)
+        words = ["the", "god", "orbit", "text", "here"]
+        (glove / "glove.6B.20d.txt").write_text("\n".join(
+            w + " " + " ".join(f"{v:.4f}" for v in rng.randn(20))
+            for w in words))
+        textclassifier.train(["--folder", str(base), "-b", "8", "-e", "1",
+                              "--maxSequenceLength", "150",
+                              "--embeddingDim", "20"])
 
 
 class TestPerfHarness:

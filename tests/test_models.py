@@ -10,7 +10,8 @@ import pytest
 
 import bigdl_tpu as bt
 from bigdl_tpu import nn
-from bigdl_tpu.models import autoencoder, inception, lenet, resnet, rnn, vgg
+from bigdl_tpu.models import (autoencoder, inception, lenet, resnet, rnn,
+                              textclassifier, vgg)
 
 
 def fwd(model, x, training=False):
@@ -36,6 +37,15 @@ class TestShapes:
     def test_resnet_cifar(self):
         out = fwd(resnet.build_cifar(10, depth=20), jnp.zeros((2, 32, 32, 3)))
         assert out.shape == (2, 10)
+
+    def test_textclassifier_cnn(self):
+        # reference geometry: seq 1000 leaves a 35-wide final pool
+        assert textclassifier.conv_output_length(1000) == 35
+        out = fwd(textclassifier.build_cnn(20, 1000, 100),
+                  jnp.zeros((2, 1000, 100)))
+        assert out.shape == (2, 20)
+        with pytest.raises(ValueError):
+            textclassifier.build_cnn(20, 100, 100)
 
     @pytest.mark.parametrize("depth", [18, 50])
     def test_resnet_imagenet(self, depth):
