@@ -119,14 +119,51 @@ def parse_caffemodel(path: str) -> List[CaffeLayer]:
     return layers
 
 
+def parse_prototxt_layers(def_path: str) -> List[CaffeLayer]:
+    """Layer definitions from a ``.prototxt`` model definition (reference
+    ``CaffeLoader.loadBinary`` merges the text NetParameter first,
+    ``CaffeLoader.scala:63-66``). Text-format blobs (rare, but legal — e.g.
+    the reference test fixture ``caffe/test_persist.prototxt``) are decoded
+    into arrays like their binary counterparts."""
+    from bigdl_tpu.interop import prototxt as pt
+    net = pt.parse_file(def_path)
+    layers: List[CaffeLayer] = []
+    for entry in net.get("layer", []) + net.get("layers", []):
+        name = pt.first(entry, "name", "")
+        type_ = pt.first(entry, "type", "")
+        if isinstance(type_, int):  # V1 enum number
+            type_ = _V1_TYPES.get(type_, str(type_))
+        blobs = []
+        for blob in entry.get("blobs", []):
+            data = np.asarray(blob.get("data", []), np.float32)
+            shape = blob.get("shape")
+            if shape:
+                dims = shape[0].get("dim", [])
+            else:
+                dims = [pt.first(blob, k, 0)
+                        for k in ("num", "channels", "height", "width")]
+                dims = [d if d else 1 for d in dims] if any(dims) else []
+            if dims and int(np.prod(dims)) == data.size:
+                data = data.reshape(dims)
+            blobs.append(data)
+        if name:
+            layers.append(CaffeLayer(str(name), str(type_), blobs))
+    return layers
+
+
 class CaffeLoader:
     """Copy caffemodel weights by layer name into an existing model
-    (reference ``CaffeLoader.copyParameters``)."""
+    (reference ``CaffeLoader.copyParameters``). ``def_path`` merges the
+    prototxt definition the way ``TextFormat.merge`` + binary ``mergeFrom``
+    do: the definition contributes the layer-name universe (and any text
+    blobs); binary blobs win when both exist."""
 
-    def __init__(self, model, model_path: str, match_all: bool = True):
+    def __init__(self, model, model_path: str, match_all: bool = True,
+                 def_path: Optional[str] = None):
         self.model = model
         self.model_path = model_path
         self.match_all = match_all
+        self.def_path = def_path
 
     def _copy_conv(self, module, layer: CaffeLayer) -> None:
         w = layer.blobs[0]
@@ -147,14 +184,26 @@ class CaffeLoader:
 
     def copy_parameters(self):
         from bigdl_tpu import nn
-        layers = {l.name: l for l in parse_caffemodel(self.model_path)}
+        layers: Dict[str, CaffeLayer] = {}
+        if self.def_path:
+            layers.update(
+                (l.name, l) for l in parse_prototxt_layers(self.def_path))
+        for l in parse_caffemodel(self.model_path):
+            if l.blobs or l.name not in layers:
+                layers[l.name] = l  # binary blobs win over text definition
         copied, missed = [], []
         for name, module in self.model.named_modules():
             lname = module.get_name()
             layer = layers.get(lname)
-            if layer is None or not layer.blobs:
+            if layer is None:
                 if isinstance(module, (nn.Linear, nn.SpatialConvolution)):
                     missed.append(lname)
+                continue
+            if not layer.blobs:
+                # defined but weightless — reference keeps initialized
+                # parameters without error (CaffeLoader.scala:150-155)
+                if isinstance(module, (nn.Linear, nn.SpatialConvolution)):
+                    logger.info("%s uses initialized parameters", lname)
                 continue
             if isinstance(module, nn.SpatialConvolution):
                 self._copy_conv(module, layer)
@@ -174,7 +223,17 @@ class CaffeLoader:
         return self.model
 
 
-def load_caffe(model, model_path: str, match_all: bool = True):
-    """Reference ``Module.loadCaffe(defPath, modelPath, matchAll)`` — the
-    prototxt is not needed for weight copy (names live in the caffemodel)."""
-    return CaffeLoader(model, model_path, match_all).copy_parameters()
+def load_caffe(model, *paths: str, match_all: bool = True):
+    """Reference ``Module.loadCaffe(defPath, modelPath, matchAll)``
+    (``CaffeLoader.scala:154``). Accepts either ``load_caffe(model,
+    model_path)`` — names live in the caffemodel, so the definition is
+    optional — or the reference's full ``load_caffe(model, def_path,
+    model_path)`` form."""
+    if len(paths) == 1:
+        def_path, model_path = None, paths[0]
+    elif len(paths) == 2:
+        def_path, model_path = paths
+    else:
+        raise TypeError("load_caffe(model, [def_path,] model_path)")
+    return CaffeLoader(model, model_path, match_all,
+                       def_path=def_path).copy_parameters()

@@ -289,3 +289,96 @@ class TestCaffe:
             f.write(_len_field(100, body))
         layers = parse_caffemodel(p)
         assert layers[0].blobs[0].shape == (2, 3, 4, 5)
+
+
+# ----------------------------------------------------------------- prototxt
+
+_DEPLOY_PROTOTXT = """
+# LeNet-style deploy definition
+name: "Le" "Net"        # adjacent strings concatenate
+input: "data"
+input_shape { dim: [1, 1, 28, 28] }
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"; top: "conv1"
+  convolution_param <
+    num_output: 4
+    kernel_size: 3
+    weight_filler { type: "xavier" value: 1.5e-2 }
+  >
+}
+layer {
+  name: "ip1"
+  type: "InnerProduct"
+  inner_product_param { num_output: 10 bias_term: true }
+}
+layers { name: "old" type: CONVOLUTION }
+"""
+
+
+class TestPrototxt:
+    def test_parse_grammar(self):
+        from bigdl_tpu.interop import prototxt as pt
+        net = pt.parse(_DEPLOY_PROTOTXT)
+        assert pt.first(net, "name") == "LeNet"
+        assert net["input_shape"][0]["dim"] == [1, 1, 28, 28]
+        conv, ip = net["layer"]
+        assert pt.first(conv, "name") == "conv1"
+        cp = pt.first(conv, "convolution_param")   # <...> delimiters
+        assert pt.first(cp, "num_output") == 4
+        filler = pt.first(cp, "weight_filler")
+        assert filler["value"] == [1.5e-2]
+        assert pt.first(ip, "inner_product_param")["bias_term"] == [True]
+        assert pt.first(net["layers"][0], "type") == "CONVOLUTION"  # enum
+
+    def test_parse_errors(self):
+        from bigdl_tpu.interop.prototxt import PrototxtError, parse
+        with pytest.raises(PrototxtError):
+            parse("layer { name: 'x' ")       # unclosed message
+        with pytest.raises(PrototxtError):
+            parse("name 'x'")                  # missing colon
+
+    def test_text_blobs_decoded(self, tmp_path):
+        from bigdl_tpu.interop.caffe import parse_prototxt_layers
+        p = tmp_path / "weights.prototxt"
+        p.write_text("""
+        layer {
+          name: "conv1" type: "Convolution"
+          blobs { shape { dim: 2 dim: 2 } data: 1 data: 2 data: 3 data: 4 }
+        }
+        """)
+        layers = parse_prototxt_layers(str(p))
+        assert layers[0].name == "conv1"
+        assert np.allclose(layers[0].blobs[0], [[1, 2], [3, 4]])
+
+    def test_load_caffe_with_def(self, tmp_path):
+        # def declares conv1+ip1; binary carries only conv1 weights.
+        # Reference semantics: ip1 is defined -> keeps initialized params,
+        # no match_all error (CaffeLoader.scala:150-155).
+        rng = np.random.RandomState(9)
+        cw = rng.randn(4, 1, 3, 3).astype(np.float32)
+        d = tmp_path / "net.prototxt"
+        d.write_text("""
+        layer { name: "conv1" type: "Convolution" }
+        layer { name: "ip1" type: "InnerProduct" }
+        """)
+        m = str(tmp_path / "net.caffemodel")
+        _make_caffemodel(m, [("conv1", "Convolution", [cw])])
+        model = (nn.Sequential()
+                 .add(nn.SpatialConvolution(1, 4, 3, 3).set_name("conv1"))
+                 .add(nn.Reshape((4 * 26 * 26,)))
+                 .add(nn.Linear(4 * 26 * 26, 10).set_name("ip1")))
+        before = np.asarray(model.find_module("ip1").weight).copy()
+        loaded = load_caffe(model, str(d), m)
+        assert np.allclose(np.asarray(loaded.find_module("conv1").weight),
+                           np.transpose(cw, (2, 3, 1, 0)))
+        assert np.allclose(np.asarray(loaded.find_module("ip1").weight),
+                           before)
+        # a module absent from def AND binary still raises under match_all
+        model2 = (nn.Sequential()
+                  .add(nn.SpatialConvolution(1, 4, 3, 3).set_name("conv1"))
+                  .add(nn.Reshape((4 * 26 * 26,)))
+                  .add(nn.Linear(4 * 26 * 26, 10).set_name("elsewhere")))
+        with pytest.raises(ValueError, match="missing weights"):
+            load_caffe(model2, str(d), m)
