@@ -180,9 +180,9 @@ class Optimizer:
             return
         tag = "" if self.is_overwrite else f".{int(driver_state['neval'])}"
         file_io.save({"params": params, "buffers": buffers},
-                     os.path.join(self.checkpoint_path, f"model{tag}"))
+                     file_io.join(self.checkpoint_path, f"model{tag}"))
         file_io.save({"optim": opt_state, "driver": dict(driver_state)},
-                     os.path.join(self.checkpoint_path, f"state{tag}"))
+                     file_io.join(self.checkpoint_path, f"state{tag}"))
         logger.info("[Checkpoint] saved model%s to %s", tag, self.checkpoint_path)
 
 
@@ -268,21 +268,29 @@ class LocalOptimizer(Optimizer):
         """Newest (model, state) snapshot pair under ``checkpoint_path``
         (reference ``getLatestFile``, ``DistriOptimizer.scala:808-825``)."""
         try:
-            names = os.listdir(self.checkpoint_path)
-        except OSError:
+            names = file_io.listdir(self.checkpoint_path)
+        except (OSError, NotImplementedError):
             return None
         pairs = []
         for name in names:
             if name == "model" or name.startswith("model."):
                 state_name = "state" + name[len("model"):]
                 if state_name in names:
-                    path = os.path.join(self.checkpoint_path, name)
-                    pairs.append((os.path.getmtime(path), name, state_name))
+                    # order by snapshot number first (reference getLatestFile
+                    # parses the numeric suffix); mtime only breaks ties and
+                    # ranks the suffix-less overwrite-mode "model" pair
+                    try:
+                        neval = int(name[len("model."):])
+                    except ValueError:
+                        neval = -1
+                    path = file_io.join(self.checkpoint_path, name)
+                    pairs.append((neval, file_io.getmtime(path),
+                                  name, state_name))
         if not pairs:
             return None
-        _, model_name, state_name = max(pairs)
-        return (os.path.join(self.checkpoint_path, model_name),
-                os.path.join(self.checkpoint_path, state_name))
+        _, _, model_name, state_name = max(pairs)
+        return (file_io.join(self.checkpoint_path, model_name),
+                file_io.join(self.checkpoint_path, state_name))
 
     def _run_training(self, resume: Optional[Tuple[str, str]]) -> Module:
         model = self.model

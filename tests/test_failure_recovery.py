@@ -113,6 +113,20 @@ class TestRetryFromCheckpoint:
         assert model_path.endswith("model.20")
         assert state_path.endswith("state.20")
 
+    def test_latest_checkpoint_orders_numerically(self, tmp_path):
+        # model.9 vs model.12: the snapshot number decides, not the
+        # lexicographic name or filesystem mtime
+        from bigdl_tpu.utils import file_io
+        opt = Optimizer(_model(), _dataset(), nn.ClassNLLCriterion())
+        opt.set_checkpoint(str(tmp_path), Trigger.every_epoch())
+        for tag, mtime in (("model.12", 100), ("model.9", 200)):
+            state = tag.replace("model", "state")
+            file_io.save({"x": 1}, str(tmp_path / tag))
+            file_io.save({"x": 1}, str(tmp_path / state))
+            os.utime(str(tmp_path / tag), (mtime, mtime))
+        model_path, _ = opt._latest_checkpoint()
+        assert model_path.endswith("model.12")
+
     def test_resume_continues_counting(self, tmp_path):
         # checkpoint at epoch boundary, then resume in a fresh optimizer:
         # epoch/neval continue rather than restart (reference §5.4)
