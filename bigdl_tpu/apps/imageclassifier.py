@@ -1,0 +1,85 @@
+"""Batch image-classification example (reference
+``example/imageclassification/ImagePredictor.scala``: load a model, run
+distributed predict over a folder of images, emit (path, predicted class)
+rows — the Spark-DataFrame part maps to a plain table of rows here).
+
+    python -m bigdl_tpu.apps.imageclassifier -f photos/ \
+        -m alexnet -t caffe --caffeDefPath deploy.prototxt \
+        --modelPath bvlc_alexnet.caffemodel -b 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+from bigdl_tpu.apps import modelvalidator
+from bigdl_tpu.dataset.base import DataSet
+from bigdl_tpu.dataset.image import (BGRImgCropper, BGRImgNormalizer,
+                                     BGRImgToBatch, LocalImgReader)
+from bigdl_tpu.optim import Predictor
+from bigdl_tpu.utils.logger_filter import redirect_logs
+
+log = logging.getLogger("bigdl_tpu.optim")
+
+
+def list_images(folder: str):
+    """Flat or nested folder -> sorted image file paths (labels unknown);
+    non-image files (READMEs, label csvs, dotfiles) are skipped."""
+    from bigdl_tpu.dataset.image import IMAGE_EXTENSIONS
+    paths = []
+    for root, _, names in os.walk(folder):
+        for n in sorted(names):
+            if n.lower().endswith(IMAGE_EXTENSIONS):
+                paths.append(os.path.join(root, n))
+    return sorted(paths)
+
+
+def predict_folder(model, folder: str, batch_size: int,
+                   crop: int, mean, std):
+    """(path, 1-based predicted class) rows."""
+    paths = list_images(folder)
+    if not paths:
+        return []
+    ds = (DataSet.array([(p, 0.0) for p in paths])
+          >> LocalImgReader(scale_to=max(256, crop))
+          >> BGRImgCropper(crop, crop, random=False)
+          >> BGRImgNormalizer(mean, std)
+          >> BGRImgToBatch(batch_size, drop_remainder=False))
+    preds = Predictor(model, batch_size).predict_class(ds)
+    flat = np.concatenate([np.asarray(p) for p in preds])
+    return list(zip(paths, flat[:len(paths)].tolist()))
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="bigdl_tpu.apps.imageclassifier")
+    p.add_argument("-f", "--folder", required=True)
+    p.add_argument("-m", "--modelName", required=True)
+    p.add_argument("-t", "--modelType", required=True,
+                   choices=["torch", "caffe", "bigdl"])
+    p.add_argument("--caffeDefPath", default=None)
+    p.add_argument("--modelPath", required=True)
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--classNum", type=int, default=1000)
+    p.add_argument("--imageSize", type=int, default=None)
+    args = p.parse_args(argv)
+    redirect_logs()
+
+    if args.modelName not in modelvalidator._MODELS:
+        raise SystemExit(f"unknown model {args.modelName!r}; "
+                         f"choose from {sorted(modelvalidator._MODELS)}")
+    _, crop, mean, std = modelvalidator._MODELS[args.modelName]
+    model = modelvalidator.load_model(args)
+    rows = predict_folder(model, args.folder, args.batchSize,
+                          args.imageSize or crop, mean, std)
+    for path, cls in rows:
+        print(f"{path}\t{int(cls)}")
+    log.info("predicted %d images", len(rows))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""Model-import validation CLI (reference
+``example/loadmodel/ModelValidator.scala``): load a BigDL/Torch/Caffe
+snapshot into a named model architecture and measure Top1/Top5 over a
+labeled image folder.
+
+    python -m bigdl_tpu.apps.modelvalidator \
+        -t caffe -m alexnet --caffeDefPath deploy.prototxt \
+        --modelPath bvlc_alexnet.caffemodel -f val_images/ -b 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import Iterator
+
+import numpy as np
+
+from bigdl_tpu.dataset.base import DataSet, Transformer
+from bigdl_tpu.dataset.image import (BGRImgCropper, BGRImgNormalizer,
+                                     BGRImgToBatch, LabeledImage,
+                                     LocalImgReader, image_folder_paths)
+from bigdl_tpu.models import alexnet, inception, lenet, resnet, vgg
+from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy
+from bigdl_tpu.utils.logger_filter import redirect_logs
+
+log = logging.getLogger("bigdl_tpu.optim")
+
+# model name -> (builder(class_num), crop size, per-channel BGR mean, std)
+_IMAGENET_BGR_MEAN = (104.0, 117.0, 123.0)
+_MODELS = {
+    "alexnet": (alexnet.build, 227, _IMAGENET_BGR_MEAN, (1.0, 1.0, 1.0)),
+    "inception": (inception.build, 224, _IMAGENET_BGR_MEAN, (1.0, 1.0, 1.0)),
+    "vgg16": (lambda n: vgg.build_imagenet(n, depth=16), 224,
+              _IMAGENET_BGR_MEAN, (1.0, 1.0, 1.0)),
+    "vgg19": (lambda n: vgg.build_imagenet(n, depth=19), 224,
+              _IMAGENET_BGR_MEAN, (1.0, 1.0, 1.0)),
+    "resnet50": (lambda n: resnet.build(n, depth=50), 224,
+                 _IMAGENET_BGR_MEAN, (1.0, 1.0, 1.0)),
+    "lenet": (lenet.build, 28, (33.0,) * 3, (78.0,) * 3),
+}
+
+
+class SubtractMeanImage(Transformer[LabeledImage, LabeledImage]):
+    """Subtract a full mean image (reference AlexNetPreprocessor's
+    ``--meanFile`` binaryproto path, ``example/loadmodel/DatasetUtil.scala``).
+    The mean is center-cropped to each image's shape."""
+
+    def __init__(self, mean: np.ndarray):
+        self.mean = mean  # (H, W, C) BGR
+
+    def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        for img in prev:
+            h, w = img.data.shape[:2]
+            mh, mw = self.mean.shape[:2]
+            if mh < h or mw < w:
+                raise ValueError(
+                    f"mean image ({mh}x{mw}) is smaller than the cropped "
+                    f"input ({h}x{w}); use a larger mean file or a smaller "
+                    f"--imageSize")
+            y, x = (mh - h) // 2, (mw - w) // 2
+            yield LabeledImage(img.data - self.mean[y:y + h, x:x + w],
+                               img.label)
+
+
+def load_model(args):
+    """Build the named architecture and fill weights per --modelType
+    (reference ``ModelValidator.scala`` match on TorchModel/CaffeModel/
+    BigDlModel)."""
+    if args.modelName not in _MODELS:
+        raise SystemExit(f"unknown model {args.modelName!r}; "
+                         f"choose from {sorted(_MODELS)}")
+    builder = _MODELS[args.modelName][0]
+    if args.modelType == "bigdl":
+        from bigdl_tpu.utils import file_io
+        return file_io.load(args.modelPath)
+    if args.modelType == "torch":
+        from bigdl_tpu.interop import load_torch
+        return load_torch(args.modelPath)
+    if args.modelType == "caffe":
+        from bigdl_tpu.interop import load_caffe
+        model = builder(args.classNum)
+        if args.caffeDefPath:
+            return load_caffe(model, args.caffeDefPath, args.modelPath)
+        return load_caffe(model, args.modelPath)
+    raise SystemExit("only torch, caffe or bigdl supported")
+
+
+def build_dataset(args):
+    name = args.modelName
+    _, crop, mean, std = _MODELS[name]
+    crop = args.imageSize or crop
+    ds = (DataSet.array(image_folder_paths(args.folder))
+          >> LocalImgReader(scale_to=max(256, crop))
+          >> BGRImgCropper(crop, crop, random=False))
+    if args.meanFile:
+        from bigdl_tpu.interop.caffe import load_mean_file
+        ds = ds >> SubtractMeanImage(load_mean_file(args.meanFile))
+    else:
+        ds = ds >> BGRImgNormalizer(mean, std)
+    return ds >> BGRImgToBatch(args.batchSize, drop_remainder=False)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="bigdl_tpu.apps.modelvalidator")
+    p.add_argument("-f", "--folder", required=True,
+                   help="labeled image folder (one subdir per class)")
+    p.add_argument("-m", "--modelName", required=True,
+                   help=f"one of {sorted(_MODELS)}")
+    p.add_argument("-t", "--modelType", required=True,
+                   choices=["torch", "caffe", "bigdl"])
+    p.add_argument("--caffeDefPath", default=None)
+    p.add_argument("--modelPath", required=True)
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--meanFile", default=None,
+                   help="caffe binaryproto mean image")
+    p.add_argument("--classNum", type=int, default=1000)
+    p.add_argument("--imageSize", type=int, default=None)
+    args = p.parse_args(argv)
+    redirect_logs()
+
+    model = load_model(args)
+    ds = build_dataset(args)
+    results = model.evaluate(ds, [Top1Accuracy(), Top5Accuracy()])
+    for result, method in results:
+        log.info("%s is %s", method.name, result)
+        print(f"{args.modelName} {method.name}: {result}")
+
+
+if __name__ == "__main__":
+    main()

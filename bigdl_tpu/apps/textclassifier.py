@@ -83,7 +83,7 @@ def prepare(args):
     to_indexed = TokensToIndexedSample(word2index, args.maxSequenceLength)
     train_samples = list(to_indexed(iter(pairs[:split])))
     val_samples = list(to_indexed(iter(pairs[split:])))
-    return train_samples, val_samples, class_num, embeddings
+    return train_samples, val_samples, class_num, embeddings, word2index
 
 
 def train(argv) -> None:
@@ -96,7 +96,8 @@ def train(argv) -> None:
     p.add_argument("--trainingSplit", type=float, default=0.8)
     args = p.parse_args(argv)
 
-    train_samples, val_samples, class_num, embeddings = prepare(args)
+    train_samples, val_samples, class_num, embeddings, word2index = \
+        prepare(args)
     log.info("Found %d texts, %d classes.",
              len(train_samples) + len(val_samples), class_num)
     embed = IndexedToEmbeddedSample(embeddings)
@@ -116,6 +117,11 @@ def train(argv) -> None:
     trained = opt.optimize()
     if args.checkpoint:
         file_io.save(trained, f"{args.checkpoint}/model_final")
+        # everything udfpredictor needs to classify raw text later
+        file_io.save({"model": trained, "word2index": word2index,
+                      "embeddings": embeddings,
+                      "seq_len": args.maxSequenceLength},
+                     f"{args.checkpoint}/classifier_bundle")
 
 
 def main() -> None:

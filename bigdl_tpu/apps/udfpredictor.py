@@ -1,0 +1,104 @@
+"""UDF/stream text-prediction example (reference ``example/udfpredictor/``:
+registers a Spark SQL UDF over a trained text classifier and applies it to a
+static DataFrame (``DataframePredictor.scala``) or a structured stream of
+text files (``StructuredStreamPredictor.scala``).
+
+TPU-native shape: ``make_udf`` returns a plain callable ``text -> 1-based
+class`` backed by one jitted batch forward; the streaming mode polls a
+directory for new ``.txt`` files, classifying each once.
+
+    python -m bigdl_tpu.apps.textclassifier train --checkpoint ck ...
+    python -m bigdl_tpu.apps.udfpredictor --modelPath ck/classifier_bundle \
+        -f texts/ [--watch]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+from typing import Callable, List, Tuple
+
+import numpy as np
+
+from bigdl_tpu.apps.textclassifier import tokenize
+from bigdl_tpu.dataset.base import DataSet, SampleToBatch
+from bigdl_tpu.dataset.text import (IndexedToEmbeddedSample,
+                                    TokensToIndexedSample)
+from bigdl_tpu.optim import Predictor
+from bigdl_tpu.utils import file_io
+from bigdl_tpu.utils.logger_filter import redirect_logs
+
+log = logging.getLogger("bigdl_tpu.optim")
+
+
+def predict_texts(bundle, texts: List[str], batch_size: int = 32) -> List[int]:
+    """Classify raw texts with a saved classifier bundle: tokenizer ->
+    vocabulary indices -> lazy embedding -> batched forward."""
+    to_indexed = TokensToIndexedSample(bundle["word2index"],
+                                       bundle["seq_len"])
+    samples = list(to_indexed((tokenize(t), 0.0) for t in texts))
+    ds = (DataSet.array(samples)
+          >> IndexedToEmbeddedSample(bundle["embeddings"])
+          >> SampleToBatch(batch_size=batch_size, drop_remainder=False))
+    preds = Predictor(bundle["model"], batch_size).predict_class(ds)
+    flat = np.concatenate([np.asarray(p) for p in preds])
+    return flat[:len(texts)].astype(int).tolist()
+
+
+def make_udf(bundle, batch_size: int = 1) -> Callable[[str], int]:
+    """The reference's ``udf(predict _)``: a callable usable anywhere a
+    per-row function is expected."""
+    return lambda text: predict_texts(bundle, [text], batch_size)[0]
+
+
+def _classify_files(bundle, paths: List[str],
+                    batch_size: int) -> List[Tuple[str, int]]:
+    texts = []
+    for p in paths:
+        with open(p, encoding="latin-1") as f:
+            texts.append(f.read())
+    return list(zip(paths, predict_texts(bundle, texts, batch_size)))
+
+
+def run(argv=None, max_polls: int = None) -> List[Tuple[str, int]]:
+    p = argparse.ArgumentParser(prog="bigdl_tpu.apps.udfpredictor")
+    p.add_argument("--modelPath", required=True,
+                   help="classifier bundle saved by textclassifier train")
+    p.add_argument("-f", "--folder", required=True,
+                   help="directory of .txt documents")
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--watch", action="store_true",
+                   help="keep polling for new files (structured-stream mode)")
+    p.add_argument("--pollSeconds", type=float, default=2.0)
+    args = p.parse_args(argv)
+    redirect_logs()
+
+    bundle = file_io.load(args.modelPath)
+    seen = set()
+    rows: List[Tuple[str, int]] = []
+    polls = 0
+    while True:
+        paths = sorted(
+            os.path.join(args.folder, n) for n in os.listdir(args.folder)
+            if n.endswith(".txt") and n not in seen)
+        seen.update(os.path.basename(p) for p in paths)
+        if paths:
+            batch_rows = _classify_files(bundle, paths, args.batchSize)
+            for path, cls in batch_rows:
+                print(f"{path}\t{cls}")
+            rows.extend(batch_rows)
+        polls += 1
+        if not args.watch or (max_polls is not None and polls >= max_polls):
+            return rows
+        time.sleep(args.pollSeconds)
+
+
+def main() -> None:
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

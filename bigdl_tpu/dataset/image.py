@@ -258,3 +258,29 @@ class LocalImgReader(Transformer[Tuple[str, float], LabeledImage]):
                         im = im.resize((int(w * self.scale_to / h), self.scale_to))
                 arr = np.asarray(im, np.float32)[:, :, ::-1]  # RGB->BGR like reference
             yield LabeledImage(arr, label)
+
+
+IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp",
+                    ".ppm", ".tif", ".tiff")
+
+
+def image_folder_paths(folder: str, extensions=IMAGE_EXTENSIONS):
+    """(path, 1-based label) pairs from a labeled image tree — one
+    subdirectory per class, labels assigned by sorted class name (reference
+    ``DataSet.ImageFolder.paths``, ``dataset/DataSet.scala:319-558``).
+    ``extensions=None`` keeps every regular file (generic labeled-tree
+    walker, reused by the text pipeline's category loader)."""
+    import os
+    pairs = []
+    classes = sorted(d for d in os.listdir(folder)
+                     if os.path.isdir(os.path.join(folder, d)))
+    for label, cls in enumerate(classes, start=1):
+        cls_dir = os.path.join(folder, cls)
+        for name in sorted(os.listdir(cls_dir)):
+            p = os.path.join(cls_dir, name)
+            if not os.path.isfile(p):
+                continue
+            if extensions and not name.lower().endswith(extensions):
+                continue
+            pairs.append((p, float(label)))
+    return pairs

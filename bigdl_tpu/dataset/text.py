@@ -191,19 +191,13 @@ def load_category_folder(base_dir: str):
     text file per document (reference ``TextClassifier.scala:96-121``
     ``loadRawData``). Returns ``(texts, labels, class_num)`` with 1-based
     labels assigned by sorted category name."""
+    from bigdl_tpu.dataset.image import image_folder_paths
     texts, labels = [], []
-    categories = sorted(d for d in os.listdir(base_dir)
-                        if os.path.isdir(os.path.join(base_dir, d)))
-    for label, cat in enumerate(categories, start=1):
-        cat_dir = os.path.join(base_dir, cat)
-        for name in sorted(os.listdir(cat_dir)):
-            p = os.path.join(cat_dir, name)
-            if not os.path.isfile(p):
-                continue
-            with open(p, encoding="latin-1") as f:
-                texts.append(f.read())
-            labels.append(float(label))
-    return texts, labels, len(categories)
+    for path, label in image_folder_paths(base_dir, extensions=None):
+        with open(path, encoding="latin-1") as f:
+            texts.append(f.read())
+        labels.append(label)
+    return texts, labels, len(set(labels))
 
 
 class TokensToIndexedSample(Transformer[tuple, Sample]):

@@ -185,9 +185,11 @@ class CaffeLoader:
     def copy_parameters(self):
         from bigdl_tpu import nn
         layers: Dict[str, CaffeLayer] = {}
+        def_names = set()
         if self.def_path:
-            layers.update(
-                (l.name, l) for l in parse_prototxt_layers(self.def_path))
+            defs = parse_prototxt_layers(self.def_path)
+            def_names = {l.name for l in defs}
+            layers.update((l.name, l) for l in defs)
         for l in parse_caffemodel(self.model_path):
             if l.blobs or l.name not in layers:
                 layers[l.name] = l  # binary blobs win over text definition
@@ -200,10 +202,17 @@ class CaffeLoader:
                     missed.append(lname)
                 continue
             if not layer.blobs:
-                # defined but weightless — reference keeps initialized
-                # parameters without error (CaffeLoader.scala:150-155)
-                if isinstance(module, (nn.Linear, nn.SpatialConvolution)):
-                    logger.info("%s uses initialized parameters", lname)
+                if lname in def_names:
+                    # declared in the definition but weightless — reference
+                    # keeps initialized parameters (CaffeLoader.scala:150-155)
+                    if isinstance(module, (nn.Linear, nn.SpatialConvolution)):
+                        logger.info("%s uses initialized parameters", lname)
+                else:
+                    # a blobless layer in the binary itself is a missing
+                    # weight (truncated/deploy-only caffemodel), not a
+                    # benign definition entry
+                    if isinstance(module, (nn.Linear, nn.SpatialConvolution)):
+                        missed.append(lname)
                 continue
             if isinstance(module, nn.SpatialConvolution):
                 self._copy_conv(module, layer)
@@ -237,3 +246,17 @@ def load_caffe(model, *paths: str, match_all: bool = True):
         raise TypeError("load_caffe(model, [def_path,] model_path)")
     return CaffeLoader(model, model_path, match_all,
                        def_path=def_path).copy_parameters()
+
+
+def load_mean_file(path: str) -> np.ndarray:
+    """Read a caffe ``.binaryproto`` mean image (a bare serialized BlobProto,
+    reference ``example/loadmodel/DatasetUtil.scala`` AlexNetPreprocessor).
+    Returns (H, W, C) float32 in caffe's BGR channel order."""
+    with open(path, "rb") as f:
+        arr = _parse_blob(memoryview(f.read()))
+    if arr.ndim == 4:  # legacy (1, C, H, W)
+        arr = arr[0]
+    if arr.ndim != 3:
+        raise ValueError(f"mean file {path} has shape {arr.shape}; "
+                         f"expected a (C, H, W) image blob")
+    return np.transpose(arr, (1, 2, 0))  # CHW -> HWC

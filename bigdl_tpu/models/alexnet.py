@@ -1,0 +1,45 @@
+"""AlexNet (reference ``example/loadmodel/AlexNet.scala`` — the Caffe
+BVLC-AlexNet geometry used by ModelValidator's import path: grouped convs,
+cross-map LRN, 227x227 BGR input). Layer names follow the Caffe deploy
+definition so ``load_caffe`` matches weights by name."""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def build(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(3, 96, 11, 11, 4, 4).set_name("conv1"))
+    m.add(nn.ReLU().set_name("relu1"))
+    m.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("norm1"))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool1"))
+    m.add(nn.SpatialConvolution(96, 256, 5, 5, 1, 1, 2, 2, n_group=2)
+          .set_name("conv2"))
+    m.add(nn.ReLU().set_name("relu2"))
+    m.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("norm2"))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool2"))
+    m.add(nn.SpatialConvolution(256, 384, 3, 3, 1, 1, 1, 1).set_name("conv3"))
+    m.add(nn.ReLU().set_name("relu3"))
+    m.add(nn.SpatialConvolution(384, 384, 3, 3, 1, 1, 1, 1, n_group=2)
+          .set_name("conv4"))
+    m.add(nn.ReLU().set_name("relu4"))
+    m.add(nn.SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1, n_group=2)
+          .set_name("conv5"))
+    m.add(nn.ReLU().set_name("relu5"))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool5"))
+    # Caffe fc6 weights contract over a C,H,W flatten; our layout is NHWC,
+    # so reorder to NCHW before flattening or imported weights are permuted
+    m.add(nn.Transpose([(2, 4), (3, 4)]))
+    m.add(nn.Reshape((256 * 6 * 6,), batch_mode=True))
+    m.add(nn.Linear(256 * 6 * 6, 4096).set_name("fc6"))
+    m.add(nn.ReLU().set_name("relu6"))
+    if has_dropout:
+        m.add(nn.Dropout(0.5).set_name("drop6"))
+    m.add(nn.Linear(4096, 4096).set_name("fc7"))
+    m.add(nn.ReLU().set_name("relu7"))
+    if has_dropout:
+        m.add(nn.Dropout(0.5).set_name("drop7"))
+    m.add(nn.Linear(4096, class_num).set_name("fc8"))
+    m.add(nn.LogSoftMax())
+    return m

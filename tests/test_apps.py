@@ -43,6 +43,60 @@ class TestTrainMains:
                               "--maxSequenceLength", "150",
                               "--embeddingDim", "20", "--checkpoint", ck])
         assert os.path.exists(os.path.join(ck, "model_final"))
+        assert os.path.exists(os.path.join(ck, "classifier_bundle"))
+
+    def test_udfpredictor_over_bundle(self, tmp_path, capsys):
+        from bigdl_tpu.apps import udfpredictor
+        ck = str(tmp_path / "ck")
+        textclassifier.train(["-b", "16", "-e", "2", "--synthetic-size", "64",
+                              "--maxSequenceLength", "150",
+                              "--embeddingDim", "16", "--checkpoint", ck])
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "one.txt").write_text("klassam klassan klassao " * 30)
+        (docs / "two.txt").write_text("klassbm klassbn klassbo " * 30)
+        rows = udfpredictor.run(["--modelPath", f"{ck}/classifier_bundle",
+                                 "-f", str(docs), "-b", "4"])
+        assert len(rows) == 2
+        out = capsys.readouterr().out
+        assert "one.txt" in out and "two.txt" in out
+        # the plain-callable UDF form works too
+        from bigdl_tpu.utils import file_io
+        udf = udfpredictor.make_udf(file_io.load(f"{ck}/classifier_bundle"))
+        assert udf("klassam klassan") in (1, 2, 3, 4)
+
+    def test_seqfilegen_round_trip(self, tmp_path, capsys):
+        from bigdl_tpu.apps import seqfilegen
+        from bigdl_tpu.dataset.shards import list_shards, read_shard
+        from PIL import Image
+        base = tmp_path / "imgs"
+        for cls in ["cat", "dog"]:
+            d = base / "train" / cls
+            d.mkdir(parents=True)
+            for i in range(5):
+                Image.new("RGB", (8, 8), (i * 20, 0, 0)).save(d / f"{i}.png")
+        out = str(tmp_path / "shards")
+        seqfilegen.main(["-f", str(base), "-o", out, "-p", "2", "-b", "3"])
+        assert "packed 10 records" in capsys.readouterr().out
+        records = [r for s in list_shards(os.path.join(out, "train"))
+                   for r in read_shard(s)]
+        assert len(records) == 10
+        assert sorted({r.label for r in records}) == [1.0, 2.0]
+
+    def test_imageclassifier_predicts(self, tmp_path, capsys, monkeypatch):
+        from bigdl_tpu.apps import imageclassifier, modelvalidator
+        from bigdl_tpu.utils import file_io
+        from test_modelvalidator import _tiny_builder, _write_folder
+        monkeypatch.setitem(modelvalidator._MODELS,
+                            "tiny", (_tiny_builder, 32,
+                                     (127.0,) * 3, (64.0,) * 3))
+        folder = _write_folder(tmp_path)
+        file_io.save(_tiny_builder(2), str(tmp_path / "snap"))
+        imageclassifier.main(["-f", folder, "-m", "tiny", "-t", "bigdl",
+                              "--modelPath", str(tmp_path / "snap"),
+                              "-b", "4"])
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 12 and all("\t" in line for line in out)
 
     def test_textclassifier_real_folder_layout(self, tmp_path):
         # 20_newsgroup-style tree + tiny GloVe file exercising the real path

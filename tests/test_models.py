@@ -38,6 +38,11 @@ class TestShapes:
         out = fwd(resnet.build_cifar(10, depth=20), jnp.zeros((2, 32, 32, 3)))
         assert out.shape == (2, 10)
 
+    def test_alexnet(self):
+        from bigdl_tpu.models import alexnet
+        out = fwd(alexnet.build(1000), jnp.zeros((1, 227, 227, 3)))
+        assert out.shape == (1, 1000)
+
     def test_textclassifier_cnn(self):
         # reference geometry: seq 1000 leaves a 35-wide final pool
         assert textclassifier.conv_output_length(1000) == 35

@@ -1,0 +1,97 @@
+"""ModelValidator CLI tests (reference
+``example/loadmodel/ModelValidator.scala``): load bigdl/caffe snapshots into
+a named architecture and validate on a labeled image folder."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.apps import modelvalidator
+from bigdl_tpu.dataset.image import image_folder_paths
+from bigdl_tpu.utils import file_io
+
+from test_interop import _make_caffemodel, _blob
+
+
+def _write_folder(tmp_path, size=32):
+    """Two classes of solid-color images: trivially separable."""
+    from PIL import Image
+    base = tmp_path / "val"
+    for cls, color in (("a_red", (255, 0, 0)), ("b_blue", (0, 0, 255))):
+        d = base / cls
+        d.mkdir(parents=True)
+        for i in range(6):
+            Image.new("RGB", (size, size), color).save(d / f"{i}.png")
+    return str(base)
+
+
+def _tiny_builder(class_num):
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1)
+                 .set_name("conv1"))
+            .add(nn.ReLU())
+            .add(nn.SpatialMaxPooling(32, 32))
+            .add(nn.Reshape((4,)))
+            .add(nn.Linear(4, class_num).set_name("ip1"))
+            .add(nn.LogSoftMax()))
+
+
+@pytest.fixture
+def tiny_registry(monkeypatch):
+    monkeypatch.setitem(modelvalidator._MODELS,
+                        "tiny", (_tiny_builder, 32,
+                                 (127.0, 127.0, 127.0), (64.0,) * 3))
+    yield
+
+
+class TestModelValidator:
+    def test_bigdl_type(self, tmp_path, tiny_registry, capsys):
+        folder = _write_folder(tmp_path)
+        model = _tiny_builder(2)
+        file_io.save(model, str(tmp_path / "snap"))
+        modelvalidator.main(["-f", folder, "-m", "tiny", "-t", "bigdl",
+                             "--modelPath", str(tmp_path / "snap"),
+                             "-b", "4", "--classNum", "2"])
+        out = capsys.readouterr().out
+        assert "Top1Accuracy" in out and "Top5Accuracy" in out
+
+    def test_caffe_type_with_def(self, tmp_path, tiny_registry, capsys):
+        folder = _write_folder(tmp_path)
+        rng = np.random.RandomState(3)
+        cw = rng.randn(4, 3, 3, 3).astype(np.float32)
+        lw = rng.randn(2, 4).astype(np.float32)
+        mp = str(tmp_path / "net.caffemodel")
+        _make_caffemodel(mp, [("conv1", "Convolution", [cw]),
+                              ("ip1", "InnerProduct", [lw])])
+        dp = tmp_path / "net.prototxt"
+        dp.write_text('layer { name: "conv1" type: "Convolution" }\n'
+                      'layer { name: "ip1" type: "InnerProduct" }\n')
+        modelvalidator.main(["-f", folder, "-m", "tiny", "-t", "caffe",
+                             "--caffeDefPath", str(dp), "--modelPath", mp,
+                             "-b", "4", "--classNum", "2"])
+        assert "Top1Accuracy" in capsys.readouterr().out
+
+    def test_unknown_model_rejected(self, tmp_path):
+        with pytest.raises(SystemExit):
+            modelvalidator.main(["-f", "x", "-m", "nope9000", "-t", "bigdl",
+                                 "--modelPath", "y"])
+
+    def test_image_folder_paths_labels(self, tmp_path):
+        folder = _write_folder(tmp_path)
+        pairs = image_folder_paths(folder)
+        assert len(pairs) == 12
+        labels = {p: l for p, l in pairs}
+        assert all(l == 1.0 for p, l in pairs if "a_red" in p)
+        assert all(l == 2.0 for p, l in pairs if "b_blue" in p)
+
+    def test_mean_file(self, tmp_path):
+        from bigdl_tpu.interop.caffe import load_mean_file
+        mean = np.arange(2 * 3 * 3, dtype=np.float32).reshape(3, 3, 2)
+        # serialize (C=2, H=3, W=3) blob, CHW order
+        blob_bytes = _blob(np.transpose(mean, (2, 0, 1)))
+        # _blob wraps shape+data as BlobProto fields already
+        p = tmp_path / "mean.binaryproto"
+        p.write_bytes(blob_bytes)
+        back = load_mean_file(str(p))
+        assert back.shape == (3, 3, 2)
+        assert np.allclose(back, mean)
