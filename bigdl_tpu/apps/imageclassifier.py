@@ -69,10 +69,7 @@ def main(argv=None) -> None:
     args = p.parse_args(argv)
     redirect_logs()
 
-    if args.modelName not in modelvalidator._MODELS:
-        raise SystemExit(f"unknown model {args.modelName!r}; "
-                         f"choose from {sorted(modelvalidator._MODELS)}")
-    _, crop, mean, std = modelvalidator._MODELS[args.modelName]
+    _, crop, mean, std = modelvalidator.model_config(args.modelName)
     model = modelvalidator.load_model(args)
     rows = predict_folder(model, args.folder, args.batchSize,
                           args.imageSize or crop, mean, std)

@@ -21,7 +21,7 @@ from bigdl_tpu.dataset.base import DataSet, Transformer
 from bigdl_tpu.dataset.image import (BGRImgCropper, BGRImgNormalizer,
                                      BGRImgToBatch, LabeledImage,
                                      LocalImgReader, image_folder_paths)
-from bigdl_tpu.models import alexnet, inception, lenet, resnet, vgg
+from bigdl_tpu.models import alexnet, inception, resnet, vgg
 from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy
 from bigdl_tpu.utils.logger_filter import redirect_logs
 
@@ -38,8 +38,15 @@ _MODELS = {
               _IMAGENET_BGR_MEAN, (1.0, 1.0, 1.0)),
     "resnet50": (lambda n: resnet.build(n, depth=50), 224,
                  _IMAGENET_BGR_MEAN, (1.0, 1.0, 1.0)),
-    "lenet": (lenet.build, 28, (33.0,) * 3, (78.0,) * 3),
 }
+
+
+def model_config(name: str):
+    """(builder, crop, mean, std) for a registry name, or a clear exit."""
+    if name not in _MODELS:
+        raise SystemExit(f"unknown model {name!r}; "
+                         f"choose from {sorted(_MODELS)}")
+    return _MODELS[name]
 
 
 class SubtractMeanImage(Transformer[LabeledImage, LabeledImage]):
@@ -68,10 +75,7 @@ def load_model(args):
     """Build the named architecture and fill weights per --modelType
     (reference ``ModelValidator.scala`` match on TorchModel/CaffeModel/
     BigDlModel)."""
-    if args.modelName not in _MODELS:
-        raise SystemExit(f"unknown model {args.modelName!r}; "
-                         f"choose from {sorted(_MODELS)}")
-    builder = _MODELS[args.modelName][0]
+    builder = model_config(args.modelName)[0]
     if args.modelType == "bigdl":
         from bigdl_tpu.utils import file_io
         return file_io.load(args.modelPath)
@@ -88,8 +92,7 @@ def load_model(args):
 
 
 def build_dataset(args):
-    name = args.modelName
-    _, crop, mean, std = _MODELS[name]
+    _, crop, mean, std = model_config(args.modelName)
     crop = args.imageSize or crop
     ds = (DataSet.array(image_folder_paths(args.folder))
           >> LocalImgReader(scale_to=max(256, crop))

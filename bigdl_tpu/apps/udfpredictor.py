@@ -50,8 +50,26 @@ def predict_texts(bundle, texts: List[str], batch_size: int = 32) -> List[int]:
 
 def make_udf(bundle, batch_size: int = 1) -> Callable[[str], int]:
     """The reference's ``udf(predict _)``: a callable usable anywhere a
-    per-row function is expected."""
-    return lambda text: predict_texts(bundle, [text], batch_size)[0]
+    per-row function is expected. The forward is jitted ONCE here and
+    reused, so per-row calls hit the compiled function instead of
+    recompiling."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu import nn
+    model = bundle["model"]
+    params, buffers = model.functional_state()
+    fwd = jax.jit(lambda p, b, x: nn.functional_apply(
+        model, p, b, x, training=False)[0])
+    to_indexed = TokensToIndexedSample(bundle["word2index"],
+                                       bundle["seq_len"])
+    embed = IndexedToEmbeddedSample(bundle["embeddings"])
+
+    def udf(text: str) -> int:
+        sample = next(embed(to_indexed(iter([(tokenize(text), 0.0)]))))
+        out = fwd(params, buffers, jnp.asarray(sample.feature)[None])
+        return int(jnp.argmax(out, axis=-1)[0]) + 1
+
+    return udf
 
 
 def _classify_files(bundle, paths: List[str],

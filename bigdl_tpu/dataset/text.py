@@ -197,7 +197,9 @@ def load_category_folder(base_dir: str):
         with open(path, encoding="latin-1") as f:
             texts.append(f.read())
         labels.append(label)
-    return texts, labels, len(set(labels))
+    # max, not len(set(...)): an empty category dir still consumed a label
+    # slot, and the model's output width must cover every assigned label
+    return texts, labels, int(max(labels)) if labels else 0
 
 
 class TokensToIndexedSample(Transformer[tuple, Sample]):
