@@ -34,21 +34,25 @@ from bigdl_tpu.utils.logger_filter import redirect_logs
 log = logging.getLogger("bigdl_tpu.optim")
 
 
+def _bundle_pipeline(bundle):
+    """tokens -> indices -> embedded samples, from a classifier bundle."""
+    return (TokensToIndexedSample(bundle["word2index"], bundle["seq_len"]),
+            IndexedToEmbeddedSample(bundle["embeddings"]))
+
+
 def predict_texts(bundle, texts: List[str], batch_size: int = 32) -> List[int]:
     """Classify raw texts with a saved classifier bundle: tokenizer ->
     vocabulary indices -> lazy embedding -> batched forward."""
-    to_indexed = TokensToIndexedSample(bundle["word2index"],
-                                       bundle["seq_len"])
+    to_indexed, embed = _bundle_pipeline(bundle)
     samples = list(to_indexed((tokenize(t), 0.0) for t in texts))
-    ds = (DataSet.array(samples)
-          >> IndexedToEmbeddedSample(bundle["embeddings"])
+    ds = (DataSet.array(samples) >> embed
           >> SampleToBatch(batch_size=batch_size, drop_remainder=False))
     preds = Predictor(bundle["model"], batch_size).predict_class(ds)
     flat = np.concatenate([np.asarray(p) for p in preds])
     return flat[:len(texts)].astype(int).tolist()
 
 
-def make_udf(bundle, batch_size: int = 1) -> Callable[[str], int]:
+def make_udf(bundle) -> Callable[[str], int]:
     """The reference's ``udf(predict _)``: a callable usable anywhere a
     per-row function is expected. The forward is jitted ONCE here and
     reused, so per-row calls hit the compiled function instead of
@@ -60,9 +64,7 @@ def make_udf(bundle, batch_size: int = 1) -> Callable[[str], int]:
     params, buffers = model.functional_state()
     fwd = jax.jit(lambda p, b, x: nn.functional_apply(
         model, p, b, x, training=False)[0])
-    to_indexed = TokensToIndexedSample(bundle["word2index"],
-                                       bundle["seq_len"])
-    embed = IndexedToEmbeddedSample(bundle["embeddings"])
+    to_indexed, embed = _bundle_pipeline(bundle)
 
     def udf(text: str) -> int:
         sample = next(embed(to_indexed(iter([(tokenize(text), 0.0)]))))

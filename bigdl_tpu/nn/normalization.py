@@ -43,19 +43,26 @@ class BatchNormalization(TensorModule):
         return tuple(range(input.ndim - 1))
 
     def update_output(self, input):
-        axes = self._reduce_axes(input)
         if self.training:
-            mean = jnp.mean(input, axis=axes)
-            var = jnp.var(input, axis=axes)
+            from bigdl_tpu.ops.batch_norm import batch_norm_train
+            if self.affine:
+                gamma, beta = self.weight, self.bias
+            else:
+                gamma = jnp.ones((self.n_output,), input.dtype)
+                beta = jnp.zeros((self.n_output,), input.dtype)
+            out, mean, var = batch_norm_train(input, gamma, beta, self.eps)
             n = input.size // input.shape[-1]
             unbiased = var * (n / max(1, n - 1))
             # Functional running-stat update; collected by functional_apply.
+            # stop_gradient: stats feed buffers only, never the loss.
+            mean = jax.lax.stop_gradient(mean)
+            unbiased = jax.lax.stop_gradient(unbiased)
             self.running_mean = ((1 - self.momentum) * self.running_mean
                                  + self.momentum * mean)
             self.running_var = ((1 - self.momentum) * self.running_var
                                 + self.momentum * unbiased)
-        else:
-            mean, var = self.running_mean, self.running_var
+            return out
+        mean, var = self.running_mean, self.running_var
         inv = jax.lax.rsqrt(var + self.eps)
         out = (input - mean) * inv
         if self.affine:

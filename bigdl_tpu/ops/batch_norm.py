@@ -1,0 +1,79 @@
+"""Fused training batch-norm with a hand-written VJP.
+
+Why this exists: profiling the ResNet-50 train step on a v5e chip showed
+~46% of TensorCore time in ``multiply_reduce``/``convert_reduce`` fusions —
+the reductions autodiff emits for batch-norm statistics and their chain
+through ``mean``/``var`` (separate dependent passes over the activation for
+mean, then var, then the backward's d-mean/d-var reductions). The classic
+fused form cuts this to the information-theoretic minimum:
+
+- forward: ONE pass over x computing sum(x) and sum(x*x) together
+  (independent reductions fuse; ``jnp.var``'s (x - mean)**2 depends on the
+  mean and forces a second pass), then one elementwise normalize pass;
+- backward: ONE pass computing sum(dy) and sum(dy * xhat) together, then one
+  elementwise pass for dx via the standard closed form
+  ``dx = gamma * inv / N * (N*dy - sum(dy) - xhat * sum(dy*xhat))``.
+
+Statistics accumulate in fp32 regardless of compute dtype (bf16's 8 mantissa
+bits make E[x^2] - E[x]^2 useless otherwise); outputs return in the input
+dtype. The ``mean``/``var`` outputs exist to feed running-stat buffers and
+are non-differentiable by construction (their cotangents are ignored —
+nothing in the training loss differentiates through running statistics).
+
+Reference counterpart: ``nn/BatchNormalization.scala:50`` hand-writes the
+same two-reduction backward (``backward`` sums gradOutput and
+gradOutput*(x-mean) per channel) — this is its XLA-native form.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def batch_norm_train(x, gamma, beta, eps):
+    """Normalize ``x`` over all axes but the last; returns
+    ``(out, mean, var)`` with biased ``var`` (both fp32)."""
+    out, mean, var, _, _ = _forward(x, gamma, beta, eps)
+    return out, mean, var
+
+
+def _forward(x, gamma, beta, eps):
+    x32 = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))
+    n = x.size // x.shape[-1]
+    # sum(x) and sum(x*x) are independent -> one fused pass over x
+    mean = jnp.mean(x32, axis=axes)
+    meansq = jnp.mean(x32 * x32, axis=axes)
+    var = jnp.maximum(meansq - mean * mean, 0.0)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = (x32 - mean) * inv
+    out = (xhat * gamma.astype(jnp.float32)
+           + beta.astype(jnp.float32)).astype(x.dtype)
+    return out, mean, var, inv, n
+
+
+def _fwd(x, gamma, beta, eps):
+    out, mean, var, inv, n = _forward(x, gamma, beta, eps)
+    return (out, mean, var), (x, gamma, mean, inv, n)
+
+
+def _bwd(eps, res, cts):
+    dout, _dmean, _dvar = cts  # running-stat outputs: non-differentiable
+    x, gamma, mean, inv, n = res
+    dy = dout.astype(jnp.float32)
+    xhat = (x.astype(jnp.float32) - mean) * inv
+    axes = tuple(range(x.ndim - 1))
+    # sum(dy) and sum(dy*xhat) are independent -> one fused pass
+    dbeta = jnp.sum(dy, axis=axes)
+    dgamma = jnp.sum(dy * xhat, axis=axes)
+    g32 = gamma.astype(jnp.float32)
+    dx = (g32 * inv / n) * (n * dy - dbeta - xhat * dgamma)
+    return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(gamma.dtype))
+
+
+batch_norm_train.defvjp(_fwd, _bwd)

@@ -12,9 +12,14 @@ from bigdl_tpu.utils.table import Table
 
 
 class Trigger:
-    def __init__(self, fn: Callable[[Table], bool], name: str = "trigger"):
+    def __init__(self, fn: Callable[[Table], bool], name: str = "trigger",
+                 uses_loss: bool = False):
         self._fn = fn
         self.name = name
+        # loss-sensitive triggers force the training loop to drain its
+        # one-step loss pipeline before each end_when check, so they see
+        # the CURRENT iteration's loss, not the previous one
+        self.uses_loss = uses_loss
 
     def __call__(self, state: Table) -> bool:
         return bool(self._fn(state))
@@ -71,12 +76,14 @@ class Trigger:
         def fn(state: Table) -> bool:
             return float(state.get("trainingLoss", float("inf"))) < minimum
 
-        return Trigger(fn, f"minLoss({minimum})")
+        return Trigger(fn, f"minLoss({minimum})", uses_loss=True)
 
     @staticmethod
     def and_(*triggers: "Trigger") -> "Trigger":
-        return Trigger(lambda s: all(t(s) for t in triggers), "and")
+        return Trigger(lambda s: all(t(s) for t in triggers), "and",
+                       uses_loss=any(t.uses_loss for t in triggers))
 
     @staticmethod
     def or_(*triggers: "Trigger") -> "Trigger":
-        return Trigger(lambda s: any(t(s) for t in triggers), "or")
+        return Trigger(lambda s: any(t(s) for t in triggers), "or",
+                       uses_loss=any(t.uses_loss for t in triggers))

@@ -103,6 +103,15 @@ class TestTriggers:
         assert not Trigger.max_epoch(5)(T(epoch=5, neval=1))
         assert Trigger.max_iteration(10)(T(epoch=1, neval=11))
 
+    def test_uses_loss_propagates(self):
+        # the loop drains its loss pipeline only for loss-sensitive stops
+        assert Trigger.min_loss(0.1).uses_loss
+        assert not Trigger.max_epoch(5).uses_loss
+        assert Trigger.or_(Trigger.max_epoch(5),
+                           Trigger.min_loss(0.1)).uses_loss
+        assert not Trigger.and_(Trigger.max_epoch(5),
+                                Trigger.max_iteration(2)).uses_loss
+
     def test_every_epoch_fires_once(self):
         t = Trigger.every_epoch()
         assert not t(T(epoch=1))  # mid-first-epoch: no boundary crossed yet
