@@ -335,7 +335,8 @@ def main():
         return
 
     attempts = [
-        ("resnet50-b128", "resnet50", 128, 20, 540, ""),
+        ("resnet50-b256", "resnet50", 256, 20, 540, ""),
+        ("resnet50-b128", "resnet50", 128, 20, 360, ""),
         ("resnet50-b32", "resnet50", 32, 20, 300, ""),
         ("lenet-b512", "lenet", 512, 100, 180, ""),
         ("lenet-cpu", "lenet", 512, 50, 180, "cpu"),

@@ -24,6 +24,7 @@ def _build_model(name: str):
     from bigdl_tpu.models import inception, lenet, resnet, vgg
     builders = {
         "inception_v1": lambda: (inception.build(1000), (224, 224, 3)),
+        "inception_v2": lambda: (inception.build_v2(1000), (224, 224, 3)),
         "vgg16": lambda: (vgg.build_imagenet(1000, depth=16), (224, 224, 3)),
         "vgg19": lambda: (vgg.build_imagenet(1000, depth=19), (224, 224, 3)),
         "resnet50": lambda: (resnet.build(1000, depth=50), (224, 224, 3)),

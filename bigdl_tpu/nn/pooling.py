@@ -82,6 +82,14 @@ class SpatialAveragePooling(TensorModule):
         self.count_include_pad = count_include_pad
         self.divide = divide
 
+    def ceil(self) -> "SpatialAveragePooling":
+        self.ceil_mode = True
+        return self
+
+    def floor(self) -> "SpatialAveragePooling":
+        self.ceil_mode = False
+        return self
+
     def update_output(self, input):
         squeeze = input.ndim == 3
         if squeeze:

@@ -67,6 +67,10 @@ class TestShapes:
         out = fwd(inception.build(1000), jnp.zeros((1, 224, 224, 3)))
         assert out.shape == (1, 1000)
 
+    def test_inception_v2(self):
+        out = fwd(inception.build_v2(1000), jnp.zeros((1, 224, 224, 3)))
+        assert out.shape == (1, 1000)
+
     def test_autoencoder(self):
         out = fwd(autoencoder.build(32), jnp.zeros((2, 28, 28, 1)))
         assert out.shape == (2, 784)
