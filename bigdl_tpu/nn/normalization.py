@@ -39,9 +39,6 @@ class BatchNormalization(TensorModule):
         self.register_buffer("running_mean", init.zeros((n_output,)))
         self.register_buffer("running_var", init.ones((n_output,)))
 
-    def _reduce_axes(self, input):
-        return tuple(range(input.ndim - 1))
-
     def update_output(self, input):
         if self.training:
             from bigdl_tpu.ops.batch_norm import batch_norm_train

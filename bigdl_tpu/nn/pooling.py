@@ -29,7 +29,20 @@ def _pool_padding(in_size: int, k: int, stride: int, pad: int, ceil_mode: bool):
     return pad, needed
 
 
-class SpatialMaxPooling(TensorModule):
+class _CeilModePooling(TensorModule):
+    """Shared fluent ceil()/floor() output-size mode (reference
+    ``SpatialMaxPooling.ceil()``/``SpatialAveragePooling.ceil()``)."""
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def floor(self):
+        self.ceil_mode = False
+        return self
+
+
+class SpatialMaxPooling(_CeilModePooling):
     """2-D max pooling (reference ``nn/SpatialMaxPooling.scala:43``)."""
 
     def __init__(self, kw: int, kh: int, dw: int = None, dh: int = None,
@@ -40,14 +53,6 @@ class SpatialMaxPooling(TensorModule):
         self.dh = dh if dh is not None else kh
         self.pad_w, self.pad_h = pad_w, pad_h
         self.ceil_mode = False
-
-    def ceil(self) -> "SpatialMaxPooling":
-        self.ceil_mode = True
-        return self
-
-    def floor(self) -> "SpatialMaxPooling":
-        self.ceil_mode = False
-        return self
 
     def update_output(self, input):
         squeeze = input.ndim == 3
@@ -67,7 +72,7 @@ class SpatialMaxPooling(TensorModule):
         return f"SpatialMaxPooling({self.kw}x{self.kh}, {self.dw},{self.dh})"
 
 
-class SpatialAveragePooling(TensorModule):
+class SpatialAveragePooling(_CeilModePooling):
     """2-D average pooling (reference ``nn/SpatialAveragePooling.scala:488``)."""
 
     def __init__(self, kw: int, kh: int, dw: int = 1, dh: int = 1,
@@ -81,14 +86,6 @@ class SpatialAveragePooling(TensorModule):
         self.ceil_mode = ceil_mode
         self.count_include_pad = count_include_pad
         self.divide = divide
-
-    def ceil(self) -> "SpatialAveragePooling":
-        self.ceil_mode = True
-        return self
-
-    def floor(self) -> "SpatialAveragePooling":
-        self.ceil_mode = False
-        return self
 
     def update_output(self, input):
         squeeze = input.ndim == 3

@@ -423,10 +423,14 @@ class LocalOptimizer(Optimizer):
                     if ptrig is not None and ptrig(driver_state):
                         self._summarize_parameters(params, neval)
                 driver_state["neval"] = neval + 1
+                if (getattr(self.end_when, "uses_loss", False)
+                        or getattr(self.validation_trigger, "uses_loss", False)
+                        or getattr(self.checkpoint_trigger, "uses_loss", False)):
+                    # loss-sensitive stop/hook triggers must see THIS
+                    # iteration's loss, not the pipelined previous one
+                    flush()
                 self._hooks(params, buffers, opt_state, driver_state, fwd,
-                            epoch_done=False)
-                if getattr(self.end_when, "uses_loss", False):
-                    flush()  # loss-sensitive stop: see THIS iteration's loss
+                            epoch_done=False, flush=flush)
                 if self.end_when(driver_state):  # iteration/loss-based stops
                     stop = True
                     break
@@ -460,12 +464,15 @@ class LocalOptimizer(Optimizer):
 
     # ------------------------------------------------------------------ hooks
     def _hooks(self, params, buffers, opt_state, driver_state, fwd,
-               epoch_done: bool) -> None:
+               epoch_done: bool, flush=None) -> None:
         if (self.validation_trigger is not None
                 and self.validation_trigger(driver_state)):
             self._validate(params, buffers, fwd, driver_state)
         if (self.checkpoint_trigger is not None
                 and self.checkpoint_trigger(driver_state)):
+            if flush is not None:
+                flush()  # persist an exact driver_state (trainingLoss is
+                # otherwise one pipelined iteration stale in the snapshot)
             self._save_checkpoint(self._finalize_params(params), buffers,
                                   opt_state, driver_state)
 

@@ -79,6 +79,34 @@ class TestLocalTraining:
         trained = opt.optimize()
         assert trained is model
 
+    def test_loss_sensitive_hook_sees_current_loss(self, tmp_path):
+        # The pipelined loop publishes iteration i's loss one dispatch late;
+        # a uses_loss hook trigger must force a drain so it observes THIS
+        # iteration's loss (not i-1's, and never a missing first loss).
+        from bigdl_tpu.visualization import TrainSummary
+        bt.utils.manual_seed(4)
+        seen = []
+
+        class Probe:
+            uses_loss = True
+
+            def __call__(self, state):
+                seen.append(float(state.get("trainingLoss", float("nan"))))
+                return False
+
+        model = lenet.build(10)
+        summary = TrainSummary(str(tmp_path), "probe")
+        opt = Optimizer(model, make_dataset(256, 64), nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.05)) \
+           .set_end_when(Trigger.max_iteration(4)) \
+           .set_train_summary(summary)
+        opt.validation_trigger = Probe()
+        opt.optimize()
+        summary.close()
+        logged = [v for _, v, _ in summary.read_scalar("Loss")]
+        per_iter = seen[:len(logged)]
+        assert logged and per_iter == pytest.approx(logged), (seen, logged)
+
 
 class TestDistributedTraining:
     @pytest.mark.parametrize("sync_mode", ["allreduce", "sharded"])
