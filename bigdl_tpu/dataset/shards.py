@@ -67,7 +67,47 @@ def list_shards(folder: str) -> List[str]:
                   if f.endswith(_SUFFIX))
 
 
+def _native_scan(path: str):
+    """Index + CRC-verify a whole shard in one native pass (``bt_shard_scan``:
+    C++ framing walk with multithreaded payload-CRC check); returns
+    ``(buf, [(offset, length), ...])`` over the payloads, or None when the
+    native library is unavailable."""
+    from bigdl_tpu import native
+    dll = native.load()
+    if dll is None:
+        return None
+    import ctypes
+    with open(path, "rb") as f:
+        buf = f.read()
+    # Size the index for KB-scale records first; the absolute worst case
+    # (16-byte framing around empty payloads) only on the -3 capacity retry —
+    # worst-case-first would zero-alloc ~file-size of index per shard.
+    worst = len(buf) // 16 + 1
+    cap = max(1024, min(len(buf) // 4096 + 1, worst))
+    while True:
+        offs = (ctypes.c_uint64 * cap)()
+        lens = (ctypes.c_uint64 * cap)()
+        n = dll.bt_shard_scan(buf, len(buf), offs, lens, cap, 1)
+        if n != -3:
+            break
+        cap = worst
+    if n == -1:
+        raise IOError(f"corrupt record header in {path}")
+    if n == -2:
+        raise IOError(f"corrupt record payload in {path}")
+    if n < 0:
+        raise IOError(f"shard scan failed ({n}) in {path}")
+    return buf, [(offs[i], lens[i]) for i in range(n)]
+
+
 def read_shard(path: str) -> Iterator[ByteRecord]:
+    scanned = _native_scan(path)
+    if scanned is not None:
+        buf, index = scanned
+        for off, length in index:
+            (label,) = struct.unpack_from("<f", buf, off)
+            yield ByteRecord(buf[off + 4:off + length], label)
+        return
     for record in FileReader.read_records(path):
         (label,) = struct.unpack("<f", record[:4])
         yield ByteRecord(record[4:], label)

@@ -13,6 +13,9 @@ native-adjacent:
   16 bits, multithreaded compress/decompress/add)
 - ``bt_kth_largest`` — quickselect (``utils/Util.scala:20``)
 - ``bt_set_num_threads`` — thread control (``MKL.setNumThreads``)
+- ``bt_shard_scan`` — packed-shard index + multithreaded CRC verify, the
+  bulk-ingest fast path (reference: Hadoop SequenceFile reading +
+  ``MTLabeledBGRImgToBatch``'s multithreaded decode)
 
 Bound via ctypes (no pybind11). The shared library is compiled lazily from
 ``src/*.cc`` with g++ on first import and cached next to the sources; if no
@@ -91,6 +94,10 @@ def _bind(path: str) -> ctypes.CDLL:
     dll.bt_kth_largest.argtypes = [ctypes.POINTER(ctypes.c_double),
                                    ctypes.c_size_t, ctypes.c_size_t]
     dll.bt_kth_largest.restype = ctypes.c_double
+    u64 = ctypes.POINTER(ctypes.c_uint64)
+    dll.bt_shard_scan.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                  u64, u64, ctypes.c_size_t, ctypes.c_int]
+    dll.bt_shard_scan.restype = ctypes.c_int64
     return dll
 
 

@@ -151,6 +151,11 @@ def _tree_zeros(params):
 class OptimMethod:
     """Base optimizer (reference ``optim/OptimMethod.scala:25``)."""
 
+    #: False for full-batch methods (LBFGS) that drive their own
+    #: ``optimize(feval, x)`` loop and cannot run as a per-minibatch
+    #: ``update`` inside Optimizer's jitted step.
+    supports_minibatch = True
+
     def __init__(self, learningrate: float = 1e-3, weightdecay: float = 0.0):
         self.learningrate = learningrate
         self.weightdecay = weightdecay
@@ -396,6 +401,8 @@ class LBFGS(OptimMethod):
     recursion is O(m·n) vector work best left to XLA but the control flow is
     data-dependent.
     """
+
+    supports_minibatch = False
 
     def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
                  tolfun: float = 1e-5, tolx: float = 1e-9,

@@ -132,6 +132,14 @@ class Optimizer:
         return self
 
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
+        if not getattr(method, "supports_minibatch", True):
+            # Fail at configuration time, not at step time (reference LBFGS
+            # is likewise a full-batch optimize(feval, x) driver,
+            # ``optim/LBFGS.scala:38``).
+            raise ValueError(
+                f"{type(method).__name__} is a full-batch method and cannot "
+                "drive the minibatch training loop; call "
+                "method.optimize(feval, x) directly instead")
         self.optim_method = method
         return self
 

@@ -69,6 +69,45 @@ class TestShards:
         ds = ShardFolder.files(str(tmp_path / "d"))
         assert ds.size() == 5
 
+    def test_native_scan_matches_python_reader(self, tmp_path, monkeypatch):
+        from bigdl_tpu import native
+        from bigdl_tpu.dataset import shards as sh
+        prefix = str(tmp_path / "d" / "part")
+        with ShardWriter(prefix, records_per_shard=64) as w:
+            for i in range(50):
+                w.write(float(i + 1), bytes([i % 251]) * (i * 7 % 96))
+        (path,) = list_shards(str(tmp_path / "d"))
+        native_records = list(read_shard(path)) \
+            if native.load() is not None else None
+        monkeypatch.setattr(sh, "_native_scan", lambda p: None)
+        py_records = list(read_shard(path))
+        assert len(py_records) == 50
+        if native_records is not None:
+            assert [(r.label, r.data) for r in native_records] \
+                == [(r.label, r.data) for r in py_records]
+
+    def test_native_scan_detects_corruption_and_truncation(self, tmp_path):
+        from bigdl_tpu import native
+        if native.load() is None:
+            pytest.skip("native library unavailable")
+        prefix = str(tmp_path / "d" / "part")
+        with ShardWriter(prefix, records_per_shard=64) as w:
+            for i in range(10):
+                w.write(1.0, b"payload-%d" % i)
+        (path,) = list_shards(str(tmp_path / "d"))
+        blob = open(path, "rb").read()
+        # flip a byte inside the LAST record's payload -> corrupt payload CRC
+        bad = bytearray(blob)
+        bad[-6] ^= 0xFF
+        bad_path = str(tmp_path / "bad.bigdl-shard")
+        open(bad_path, "wb").write(bytes(bad))
+        with pytest.raises(IOError, match="corrupt"):
+            list(read_shard(bad_path))
+        # truncated tail (crashed writer) is clean EOF, not an error
+        cut_path = str(tmp_path / "cut.bigdl-shard")
+        open(cut_path, "wb").write(blob[:-9])
+        assert len(list(read_shard(cut_path))) == 9
+
 
 class TestModelBroadcast:
     def test_value_device_resident(self):

@@ -103,6 +103,15 @@ class TestTriggers:
         assert not Trigger.max_epoch(5)(T(epoch=5, neval=1))
         assert Trigger.max_iteration(10)(T(epoch=1, neval=11))
 
+    def test_lbfgs_rejected_by_training_loop(self):
+        # full-batch method: configuration-time error, not a step-time crash
+        from bigdl_tpu.optim import Optimizer
+        from bigdl_tpu.dataset.base import DataSet
+        from bigdl_tpu import nn as _nn
+        opt = Optimizer.__new__(Optimizer)
+        with pytest.raises(ValueError, match="full-batch"):
+            Optimizer.set_optim_method(opt, LBFGS())
+
     def test_uses_loss_propagates(self):
         # the loop drains its loss pipeline only for loss-sensitive stops
         assert Trigger.min_loss(0.1).uses_loss
