@@ -291,25 +291,37 @@ def _attempt(name, worker, batch, steps, budget_s, platform="",
     return None
 
 
-def _probe_backend(timeout_s=120):
-    """Quick subprocess probe: is the default (TPU) backend reachable at all?
+def _probe_backend(timeout_s=120, tries=2):
+    """Subprocess probe: is the default (TPU) backend reachable at all?
     A dead tunnel otherwise eats every attempt's full budget before the CPU
-    fallback gets a chance."""
-    log(f"probing default backend (timeout {timeout_s}s)")
+    fallback gets a chance. The tunnel has been observed to wedge
+    transiently (init hangs rather than erroring), so retry once with a
+    cooldown: a long one after a hang, a short one after a fast error
+    (round-1's transient UNAVAILABLE exits quickly)."""
     code = ("import jax, sys; d = jax.devices(); "
             "print('PROBE_OK', d[0].platform, len(d))")
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-                              timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        log("probe: backend init HUNG; skipping TPU attempts")
-        return False
-    out = proc.stdout.decode(errors="replace")
-    if proc.returncode == 0 and "PROBE_OK" in out:
-        log(f"probe: {out.strip()}")
-        return True
-    log(f"probe: rc={proc.returncode}; skipping TPU attempts")
+    for attempt in range(tries):
+        log(f"probing default backend (try {attempt + 1}/{tries}, "
+            f"timeout {timeout_s}s)")
+        cooldown_s = 60
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            log("probe: backend init HUNG")
+            proc = None
+        if proc is not None:
+            out = proc.stdout.decode(errors="replace")
+            if proc.returncode == 0 and "PROBE_OK" in out:
+                log(f"probe: {out.strip()}")
+                return True
+            log(f"probe: rc={proc.returncode}")
+            cooldown_s = 30  # fast error: short cooldown covers transients
+        if attempt < tries - 1:
+            log(f"probe: cooling down {cooldown_s}s before retry")
+            time.sleep(cooldown_s)
+    log("probe: backend unreachable; skipping TPU attempts")
     return False
 
 
@@ -367,9 +379,32 @@ def main():
             uniq.append(a)
     attempts = uniq
 
+    # Global deadline: the driver kills the whole run (~25 min observed in
+    # round 1) — a wedged tunnel must never eat the window before the CPU
+    # fallback emits a number. Reserve time for one CPU attempt at the end.
+    try:
+        total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET") or 1200)
+    except ValueError:
+        log("ignoring unparseable BENCH_TOTAL_BUDGET")
+        total_budget = 1200.0
+    cpu_reserve = 240.0
+
+    def remaining():
+        return total_budget - (time.monotonic() - _T_START)
+
     if not _probe_backend():
         attempts = [a for a in attempts if a[5] == "cpu"]
     for name, worker, batch, steps, budget, platform in attempts:
+        rem = remaining() - (0 if platform == "cpu" else cpu_reserve)
+        # TPU compile alone takes minutes: an attempt whose post-clamp
+        # budget would fall under ~4 min (TPU) / 2 min (CPU) can only burn
+        # wall-clock, never succeed. rem - 90 is the clamped budget below.
+        min_useful = 240 if platform != "cpu" else 120
+        if rem - 90 < min_useful:
+            log(f"attempt {name}: SKIPPED ({remaining():.0f}s left in "
+                "global budget)")
+            continue
+        budget = min(budget, rem - 90)  # keep the kill-grace inside rem
         res = _attempt(name, worker, batch, steps, budget, platform,
                        args.precision)
         if res is not None:

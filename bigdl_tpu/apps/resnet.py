@@ -9,7 +9,7 @@ import sys
 from bigdl_tpu import nn
 from bigdl_tpu.apps.common import build_optimizer, run_test, test_parser, train_parser
 from bigdl_tpu.dataset import cifar
-from bigdl_tpu.dataset.base import DataSet
+from bigdl_tpu.dataset.base import DataSet, Prefetch
 from bigdl_tpu.dataset.image import (BGRImgNormalizer, BGRImgRdmCropper,
                                      BGRImgToBatch, HFlip)
 from bigdl_tpu.models import resnet
@@ -26,7 +26,7 @@ def _train_set(folder, batch, synthetic_size):
             else cifar.synthetic(synthetic_size))
     return (DataSet.array(imgs) >> BGRImgNormalizer(MEAN, STD)
             >> HFlip(0.5) >> BGRImgRdmCropper(32, 32, padding=4)
-            >> BGRImgToBatch(batch))
+            >> BGRImgToBatch(batch) >> Prefetch(2))
 
 
 def _val_set(folder, batch, synthetic_size):

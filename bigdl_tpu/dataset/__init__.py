@@ -2,7 +2,7 @@
 
 from bigdl_tpu.dataset.base import (
     Sample, MiniBatch, ByteRecord, Transformer, ChainedTransformer,
-    Identity as IdentityTransformer, SampleToBatch,
+    Identity as IdentityTransformer, SampleToBatch, Prefetch, MTTransformer,
     AbstractDataSet, LocalDataSet, DistributedDataSet, DataSet,
 )
 from bigdl_tpu.dataset import image

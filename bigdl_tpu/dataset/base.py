@@ -69,6 +69,11 @@ class ByteRecord:
 class Transformer(Generic[A, B]):
     """Iterator→iterator stage (reference ``dataset/Transformer.scala:41``)."""
 
+    #: True for stages whose output depends on MORE than one input record
+    #: (batching/collation). Such stages cannot be fanned out per-record by
+    #: MTTransformer.
+    aggregating = False
+
     def __call__(self, prev: Iterator[A]) -> Iterator[B]:
         raise NotImplementedError
 
@@ -90,6 +95,12 @@ class ChainedTransformer(Transformer[A, C]):
         return self.second(self.first(prev))
 
 
+def _flatten_chain(t: Transformer) -> List[Transformer]:
+    if isinstance(t, ChainedTransformer):
+        return _flatten_chain(t.first) + _flatten_chain(t.second)
+    return [t]
+
+
 class Identity(Transformer[A, A]):
     """reference ``dataset/Transformer.scala`` Identity."""
 
@@ -106,6 +117,8 @@ class SampleToBatch(Transformer[Sample, MiniBatch]):
     sequence length so XLA sees one shape). ``drop_remainder`` keeps batch
     shape static; the evaluator pads the tail batch instead.
     """
+
+    aggregating = True
 
     def __init__(self, batch_size: int,
                  feature_padding: Optional[float] = None,
@@ -151,6 +164,126 @@ class SampleToBatch(Transformer[Sample, MiniBatch]):
         if labs.ndim == 2 and labs.shape[1] == 1:
             labs = labs[:, 0]
         return MiniBatch(feats, labs)
+
+
+class Prefetch(Transformer[A, A]):
+    """Stage up to ``depth`` upstream items in a background thread so host
+    decode/augment/collate overlaps device compute.
+
+    The reference overlaps ingest with compute via thread pools
+    (``MTLabeledBGRImgToBatch``'s worker threads, ``Engine.default`` IO
+    tasks); the TPU-native form is a bounded producer queue in front of the
+    jitted step — typically placed last, after batching:
+    ``... >> GreyImgToBatch(256) >> Prefetch(2)``.
+    """
+
+    aggregating = True  # reorders time, not records; still not per-record
+
+    def __init__(self, depth: int = 2):
+        if depth < 1:
+            raise ValueError("Prefetch depth must be >= 1")
+        self.depth = depth
+
+    def __call__(self, prev: Iterator[A]) -> Iterator[A]:
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        _END, _ERR = object(), object()
+
+        def put_or_stop(item) -> bool:
+            """Blocking put that aborts when the consumer walked away —
+            EVERY producer put (items and sentinels alike) must go through
+            this, or the thread can park forever on a full queue."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for item in prev:
+                    if not put_or_stop(item):
+                        return
+                put_or_stop(_END)
+            except BaseException as e:  # propagate to the consumer
+                put_or_stop((_ERR, e))
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="bigdl-tpu-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] is _ERR:
+                    raise item[1]
+                yield item
+        finally:
+            stop.set()  # consumer abandoned/finished: unblock the producer
+
+
+class MTTransformer(Transformer[A, B]):
+    """Apply an inner transformer across ``workers`` threads, preserving
+    order (reference ``MTLabeledBGRImgToBatch``: multithreaded per-record
+    transform; numpy decode/augment releases the GIL, so threads give real
+    parallelism).
+
+    Each worker thread gets its own ``clone_transformer()`` of the inner
+    stage (matching the reference's per-thread cached transformer clones,
+    ``DataSet.scala:166-196``), so stateful stages don't race; random-augment
+    streams therefore differ from the single-threaded order. The inner stage
+    is applied per record — 1:0/1:1/1:n stages all compose (outputs are
+    flattened in input order).
+    """
+
+    def __init__(self, inner: Transformer[A, B], workers: int = 4,
+                 window: Optional[int] = None):
+        for stage in _flatten_chain(inner):
+            if stage.aggregating:
+                raise ValueError(
+                    f"MTTransformer cannot fan out {type(stage).__name__}: "
+                    "it aggregates across records (per-record invocation "
+                    "would silently produce wrong/empty output). Put "
+                    "MTTransformer around the per-record stages and chain "
+                    "the batching stage after it: mt_stage >> SampleToBatch")
+        self.inner = inner
+        self.workers = max(1, int(workers))
+        self.window = window or self.workers * 2
+
+    def __call__(self, prev: Iterator[A]) -> Iterator[B]:
+        if self.workers == 1:
+            return self.inner(prev)
+        return self._parallel(prev)
+
+    def _parallel(self, prev: Iterator[A]) -> Iterator[B]:
+        import collections
+        import concurrent.futures as cf
+        import threading
+
+        local = threading.local()
+
+        def apply_one(item):
+            t = getattr(local, "t", None)
+            if t is None:
+                t = local.t = self.inner.clone_transformer()
+            return list(t(iter([item])))
+
+        with cf.ThreadPoolExecutor(self.workers,
+                                   thread_name_prefix="bigdl-tpu-mt") as ex:
+            pending: "collections.deque" = collections.deque()
+            for item in prev:
+                pending.append(ex.submit(apply_one, item))
+                if len(pending) >= self.window:
+                    yield from pending.popleft().result()
+            while pending:
+                yield from pending.popleft().result()
 
 
 # --------------------------------------------------------------------------

@@ -197,6 +197,8 @@ class Lighting(Transformer[LabeledImage, LabeledImage]):
 
 
 class _ImgToBatch(Transformer[LabeledImage, MiniBatch]):
+    aggregating = True
+
     def __init__(self, batch_size: int, drop_remainder: bool = True):
         self.batch_size = batch_size
         self.drop_remainder = drop_remainder

@@ -1,0 +1,154 @@
+"""Prefetch + multithreaded transform stages (reference
+``MTLabeledBGRImgToBatch``'s worker threads; ``Transformer`` clone-per-thread
+contract, ``DataSet.scala:166-196``)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset.base import (DataSet, MTTransformer, Prefetch, Sample,
+                                    SampleToBatch, Transformer)
+
+
+class _Slow(Transformer):
+    def __init__(self, delay=0.005):
+        self.delay = delay
+
+    def __call__(self, prev):
+        for x in prev:
+            time.sleep(self.delay)
+            yield x * 2
+
+
+class _Expand(Transformer):
+    """1 -> 2 stage: exercises output flattening in order."""
+
+    def __call__(self, prev):
+        for x in prev:
+            yield x
+            yield -x
+
+
+class _Stateful(Transformer):
+    """Counts items per instance: proves each MT worker got its own clone."""
+
+    def __init__(self):
+        self.count = 0
+
+    def __call__(self, prev):
+        for x in prev:
+            self.count += 1
+            yield x
+
+
+class TestPrefetch:
+    def test_order_preserved(self):
+        out = list(Prefetch(3)(iter(range(100))))
+        assert out == list(range(100))
+
+    def test_composes_with_dataset(self):
+        records = [Sample(np.full((4,), i, np.float32), float(i % 2 + 1))
+                   for i in range(32)]
+        ds = DataSet.array(records) >> SampleToBatch(8) >> Prefetch(2)
+        batches = list(ds.data(train=False))
+        assert len(batches) == 4 and batches[0].size() == 8
+
+    def test_upstream_exception_propagates(self):
+        def boom():
+            yield 1
+            raise RuntimeError("upstream died")
+
+        it = Prefetch(2)(boom())
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="upstream died"):
+            list(it)
+
+    def test_abandoned_consumer_stops_producer(self):
+        before = threading.active_count()
+        it = Prefetch(1)(iter(range(10_000)))
+        next(it), next(it)
+        it.close()  # consumer walks away mid-stream
+        deadline = time.time() + 5
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.02)
+        assert threading.active_count() <= before
+
+    def test_exception_survives_full_queue_and_slow_consumer(self):
+        # Error raised while the queue is full + consumer stalled: the
+        # producer must keep trying to deliver it, not drop it and strand
+        # the consumer in q.get() forever.
+        def boom():
+            yield 1
+            yield 2
+            raise RuntimeError("late death")
+
+        it = Prefetch(1)(boom())
+        assert next(it) == 1
+        time.sleep(0.3)  # producer hits the error with the queue full
+        assert next(it) == 2
+        with pytest.raises(RuntimeError, match="late death"):
+            next(it)
+
+    def test_abandon_with_full_queue_does_not_leak_producer(self):
+        # Producer parked trying to put _END against a full queue must
+        # still exit when the consumer closes the generator.
+        before = threading.active_count()
+        it = Prefetch(1)(iter([1, 2]))
+        assert next(it) == 1  # producer now holds 2 + _END pending
+        time.sleep(0.2)
+        it.close()
+        deadline = time.time() + 5
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.02)
+        assert threading.active_count() <= before
+
+    def test_overlaps_slow_producer(self):
+        # consumer that also sleeps: total wall < sum of both sides
+        delay = 0.01
+        n = 20
+        it = Prefetch(4)(_Slow(delay)(iter(range(n))))
+        t0 = time.time()
+        for _ in it:
+            time.sleep(delay)
+        wall = time.time() - t0
+        assert wall < 2 * n * delay * 0.9, wall
+
+
+class TestMTTransformer:
+    def test_matches_sequential(self):
+        data = list(range(50))
+        seq = list(_Slow(0)(iter(data)))
+        par = list(MTTransformer(_Slow(0), workers=4)(iter(data)))
+        assert par == seq
+
+    def test_expansion_stage_order(self):
+        par = list(MTTransformer(_Expand(), workers=3)(iter([1, 2, 3])))
+        assert par == [1, -1, 2, -2, 3, -3]
+
+    def test_workers_get_private_clones(self):
+        inner = _Stateful()
+        out = list(MTTransformer(inner, workers=4)(iter(range(200))))
+        assert len(out) == 200
+        assert inner.count == 0  # original untouched: clones did the work
+
+    def test_rejects_aggregating_stage(self):
+        with pytest.raises(ValueError, match="aggregates"):
+            MTTransformer(SampleToBatch(32), workers=4)
+        with pytest.raises(ValueError, match="aggregates"):
+            MTTransformer(_Slow() >> SampleToBatch(8), workers=2)
+
+    def test_single_worker_is_passthrough(self):
+        inner = _Stateful()
+        out = list(MTTransformer(inner, workers=1)(iter(range(5))))
+        assert out == list(range(5)) and inner.count == 5
+
+    def test_speedup_on_gil_releasing_work(self):
+        # time.sleep releases the GIL like numpy does; 4 workers on a
+        # 5 ms/item stage should be well under the sequential wall
+        n, delay = 40, 0.005
+        t0 = time.time()
+        list(MTTransformer(_Slow(delay), workers=4)(iter(range(n))))
+        wall = time.time() - t0
+        assert wall < n * delay * 0.75, wall
