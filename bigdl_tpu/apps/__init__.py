@@ -14,3 +14,9 @@ Every app runs on synthetic data when no ``-f`` folder is given (the
 reference's Perf mains use constant|random synthetic input the same way), so
 each path is drivable without datasets.
 """
+
+from bigdl_tpu.apps.common import ensure_platform
+
+# Honor a user-set JAX_PLATFORMS for every `python -m bigdl_tpu.apps.*`
+# entry point (site hooks can override the env var at interpreter start).
+ensure_platform()

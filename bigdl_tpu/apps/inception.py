@@ -1,5 +1,6 @@
-"""Inception-v1 ImageNet-shape train main + Caffe/Torch model-import path
-(reference ``models/inception/Train.scala:1-118`` and
+"""Inception ImageNet train main + Caffe/Torch model-import path
+(reference ``models/inception/Train.scala:1-118``,
+``models/inception/ImageNet2012.scala`` shard pipeline, and
 ``example/loadmodel/ModelValidator.scala``)."""
 
 from __future__ import annotations
@@ -10,10 +11,15 @@ import numpy as np
 
 from bigdl_tpu import nn
 from bigdl_tpu.apps.common import build_optimizer, train_parser
-from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+from bigdl_tpu.dataset.base import DataSet, Prefetch, Sample, SampleToBatch
 from bigdl_tpu.models import inception
 from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy
 from bigdl_tpu.utils import file_io
+
+# ImageNet channel stats, BGR order (reference ``ImageNet2012.scala``
+# normalizes with 0.485/0.456/0.406 RGB means, 0.229/0.224/0.225 stds x255)
+_MEAN_BGR = (0.406 * 255, 0.456 * 255, 0.485 * 255)
+_STD_BGR = (0.225 * 255, 0.224 * 255, 0.229 * 255)
 
 
 def _synthetic_imagenet(n: int, size: int = 224, classes: int = 1000):
@@ -22,7 +28,30 @@ def _synthetic_imagenet(n: int, size: int = 224, classes: int = 1000):
                    np.float32(rng.randint(1, classes + 1))) for _ in range(n)]
 
 
-def _dataset(batch, synthetic_size):
+def _shard_dataset(folder: str, batch: int, train: bool):
+    """The reference ``ImageNet2012.scala`` pipeline over packed shards
+    (``apps.seqfilegen`` output): decode -> 224-crop (+flip when training)
+    -> normalize -> batch, with the decode fanned across threads and the
+    batches prefetched ahead of the device."""
+    from bigdl_tpu.dataset.base import MTTransformer
+    from bigdl_tpu.dataset.image import (BGRImgCropper, BGRImgNormalizer,
+                                         BGRImgRdmCropper, BGRImgToBatch,
+                                         EncodedBytesToBGRImg, HFlip)
+    from bigdl_tpu.dataset.shards import ShardFolder
+    ds = ShardFolder.stream(folder)  # one shard resident at a time
+    decode = MTTransformer(EncodedBytesToBGRImg(256), workers=8)
+    if train:
+        aug = HFlip(0.5) >> BGRImgRdmCropper(224, 224)
+    else:
+        aug = BGRImgCropper(224, 224, random=False)
+    return (ds >> decode >> aug >> BGRImgNormalizer(_MEAN_BGR, _STD_BGR)
+            >> BGRImgToBatch(batch, drop_remainder=train)
+            >> Prefetch(2))
+
+
+def _dataset(batch, synthetic_size, folder=None, train=True):
+    if folder:
+        return _shard_dataset(folder, batch, train)
     return DataSet.array(_synthetic_imagenet(synthetic_size)).transform(
         SampleToBatch(batch_size=batch))
 
@@ -43,10 +72,15 @@ def train(argv) -> None:
         if args.caffeModel:
             from bigdl_tpu.interop import load_caffe
             model = load_caffe(model, args.caffeModel, match_all=False)
-    opt = build_optimizer(model, _dataset(args.batchSize, args.synthetic_size),
+    train_folder = f"{args.folder}/train" if args.folder else None
+    val_folder = f"{args.folder}/val" if args.folder else None
+    opt = build_optimizer(model,
+                          _dataset(args.batchSize, args.synthetic_size,
+                                   train_folder, train=True),
                           nn.ClassNLLCriterion(), args,
                           validation_set=_dataset(args.batchSize,
-                                                  args.synthetic_size),
+                                                  args.synthetic_size,
+                                                  val_folder, train=False),
                           methods=[Top1Accuracy(), Top5Accuracy()])
     trained = opt.optimize()
     if args.checkpoint:

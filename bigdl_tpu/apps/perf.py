@@ -20,15 +20,23 @@ import time
 import numpy as np
 
 
+_LSTM_VOCAB = 20_000
+
+
 def _build_model(name: str):
-    from bigdl_tpu.models import inception, lenet, resnet, vgg
+    """(model, feature_shape, n_classes, int_vocab) — int_vocab > 0 marks
+    integer token-index features (the LSTM text-classification workload,
+    BASELINE config 5 / reference ``models/rnn`` + ``example/textclassification``)."""
+    from bigdl_tpu.models import inception, lenet, resnet, rnn, vgg
     builders = {
-        "inception_v1": lambda: (inception.build(1000), (224, 224, 3)),
-        "inception_v2": lambda: (inception.build_v2(1000), (224, 224, 3)),
-        "vgg16": lambda: (vgg.build_imagenet(1000, depth=16), (224, 224, 3)),
-        "vgg19": lambda: (vgg.build_imagenet(1000, depth=19), (224, 224, 3)),
-        "resnet50": lambda: (resnet.build(1000, depth=50), (224, 224, 3)),
-        "lenet5": lambda: (lenet.build(10), (28, 28, 1)),
+        "inception_v1": lambda: (inception.build(1000), (224, 224, 3), 1000, 0),
+        "inception_v2": lambda: (inception.build_v2(1000), (224, 224, 3), 1000, 0),
+        "vgg16": lambda: (vgg.build_imagenet(1000, depth=16), (224, 224, 3), 1000, 0),
+        "vgg19": lambda: (vgg.build_imagenet(1000, depth=19), (224, 224, 3), 1000, 0),
+        "resnet50": lambda: (resnet.build(1000, depth=50), (224, 224, 3), 1000, 0),
+        "lenet5": lambda: (lenet.build(10), (28, 28, 1), 10, 0),
+        "lstm": lambda: (rnn.build_classifier(_LSTM_VOCAB, 128, 128, 20),
+                         (500,), 20, _LSTM_VOCAB),
     }
     if name not in builders:
         raise SystemExit(f"unknown model {name}; one of {sorted(builders)}")
@@ -58,12 +66,17 @@ def main(argv=None) -> None:
     from bigdl_tpu.utils.logger_filter import redirect_logs
 
     redirect_logs()
-    model, shape = _build_model(args.model)
-    n_class = 1000 if args.model != "lenet5" else 10
+    model, shape, n_class, int_vocab = _build_model(args.model)
 
     rng = np.random.RandomState(0)
     n_records = args.batchSize * 2  # endless shuffled iterator re-serves them
-    if args.dataType == "constant":
+    if int_vocab:  # 1-based token indices (LookupTable input)
+        if args.dataType == "constant":
+            feats = [np.ones(shape, np.float32) for _ in range(n_records)]
+        else:
+            feats = [rng.randint(1, int_vocab + 1, shape).astype(np.float32)
+                     for _ in range(n_records)]
+    elif args.dataType == "constant":
         feats = [np.ones(shape, np.float32) for _ in range(n_records)]
     else:
         feats = [rng.randn(*shape).astype(np.float32)

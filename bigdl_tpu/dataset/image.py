@@ -237,6 +237,41 @@ class BGRImgToSample(GreyImgToSample):
     """reference ``BGRImgToSample``."""
 
 
+def _decode_scaled_bgr(source, scale_to: int, who: str) -> np.ndarray:
+    """Shared PIL decode: RGB convert, short side to ``scale_to``, RGB->BGR
+    float32 (the reference's BGR convention)."""
+    try:
+        from PIL import Image as PILImage
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(f"{who} requires Pillow") from e
+    with PILImage.open(source) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        if min(w, h) != scale_to:
+            if w < h:
+                im = im.resize((scale_to, int(h * scale_to / w)))
+            else:
+                im = im.resize((int(w * scale_to / h), scale_to))
+        return np.asarray(im, np.float32)[:, :, ::-1]
+
+
+class EncodedBytesToBGRImg(Transformer[ByteRecord, LabeledImage]):
+    """Decode encoded (JPEG/PNG/...) bytes to a scaled BGR image — the
+    shard-ingest decode stage (reference seq-file path:
+    ``LocalSeqFileToBytes`` -> decode; scaling rule as ``LocalImgReader``:
+    short side to ``scale_to``). Requires Pillow."""
+
+    def __init__(self, scale_to: int = 256):
+        self.scale_to = scale_to
+
+    def __call__(self, prev: Iterator[ByteRecord]) -> Iterator[LabeledImage]:
+        import io
+        for rec in prev:
+            arr = _decode_scaled_bgr(io.BytesIO(rec.data), self.scale_to,
+                                     type(self).__name__)
+            yield LabeledImage(arr, rec.label)
+
+
 class LocalImgReader(Transformer[Tuple[str, float], LabeledImage]):
     """Read + scale image files from disk (reference ``LocalImgReader``).
     Items are (path, label). Requires Pillow; raises cleanly otherwise."""
@@ -245,21 +280,10 @@ class LocalImgReader(Transformer[Tuple[str, float], LabeledImage]):
         self.scale_to = scale_to
 
     def __call__(self, prev: Iterator[Tuple[str, float]]) -> Iterator[LabeledImage]:
-        try:
-            from PIL import Image as PILImage
-        except ImportError as e:  # pragma: no cover
-            raise RuntimeError("LocalImgReader requires Pillow") from e
         for path, label in prev:
-            with PILImage.open(path) as im:
-                im = im.convert("RGB")
-                w, h = im.size
-                if min(w, h) != self.scale_to:
-                    if w < h:
-                        im = im.resize((self.scale_to, int(h * self.scale_to / w)))
-                    else:
-                        im = im.resize((int(w * self.scale_to / h), self.scale_to))
-                arr = np.asarray(im, np.float32)[:, :, ::-1]  # RGB->BGR like reference
-            yield LabeledImage(arr, label)
+            yield LabeledImage(
+                _decode_scaled_bgr(path, self.scale_to, type(self).__name__),
+                label)
 
 
 IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp",

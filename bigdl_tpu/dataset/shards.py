@@ -15,7 +15,8 @@ import os
 import struct
 from typing import Iterator, List, Optional, Sequence
 
-from bigdl_tpu.dataset.base import ByteRecord, DataSet, LocalDataSet
+from bigdl_tpu.dataset.base import (AbstractDataSet, ByteRecord, DataSet,
+                                    LocalDataSet)
 from bigdl_tpu.visualization.tensorboard import FileReader, RecordWriter
 
 _SUFFIX = ".bigdl-shard"
@@ -132,6 +133,8 @@ class ShardFolder:
     @staticmethod
     def files(folder: str, host_index: Optional[int] = None,
               host_count: Optional[int] = None) -> LocalDataSet:
+        """Eagerly materialized dataset — fine for fixture-scale folders;
+        use :meth:`stream` for ImageNet-scale data."""
         records: List[ByteRecord] = []
         for path in ShardFolder.paths(folder, host_index, host_count):
             records.extend(read_shard(path))
@@ -139,3 +142,53 @@ class ShardFolder:
         # dataset distributed WITHOUT re-slicing per process
         from bigdl_tpu.dataset.base import DistributedDataSet
         return DistributedDataSet(records, shard_by_process=False)
+
+    @staticmethod
+    def stream(folder: str, host_index: Optional[int] = None,
+               host_count: Optional[int] = None) -> "StreamingShardDataSet":
+        """Streaming dataset: one shard resident at a time (the reference
+        reads SequenceFiles partition-by-partition; whole-corpus RAM
+        residency is not an option at ImageNet scale)."""
+        return StreamingShardDataSet(
+            ShardFolder.paths(folder, host_index, host_count))
+
+
+class StreamingShardDataSet(AbstractDataSet):
+    """DataSet over shard files that re-reads from disk each epoch.
+
+    Shuffle granularity (reference ``CachedDistriDataSet`` shuffles a cached
+    index; here disk order is the index): shard ORDER is permuted per epoch
+    and records shuffle WITHIN the resident shard — one shard's records in
+    RAM at a time bounds memory at max-shard-size.
+    """
+
+    def __init__(self, paths: Sequence[str]):
+        if not paths:
+            raise ValueError("no shard files given")
+        self._paths = list(paths)
+        self._order = list(range(len(self._paths)))
+        self._size: Optional[int] = None
+        self._shuffled = False
+
+    def data(self, train: bool) -> Iterator[ByteRecord]:
+        from bigdl_tpu.utils.rng import RandomGenerator
+        for i in self._order:
+            records = list(read_shard(self._paths[i]))
+            if self._shuffled:
+                RandomGenerator.RNG().shuffle(records)
+            yield from records
+
+    def size(self) -> int:
+        if self._size is None:
+            self._size = sum(1 for p in self._paths for _ in read_shard(p))
+        return self._size
+
+    def shuffle(self) -> None:
+        from bigdl_tpu.utils.rng import RandomGenerator
+        RandomGenerator.RNG().shuffle(self._order)
+        self._shuffled = True
+
+    def is_distributed(self) -> bool:
+        # paths are already host-sliced (ShardFolder.paths): same contract
+        # as files()'s DistributedDataSet(shard_by_process=False)
+        return True

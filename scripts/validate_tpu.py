@@ -134,12 +134,9 @@ def main():
                           os.path.join(os.path.dirname(
                               os.path.dirname(os.path.abspath(__file__))),
                               ".jax_cache"))
+    from bigdl_tpu.apps.common import ensure_platform
+    ensure_platform()
     import jax
-    forced = os.environ.get("JAX_PLATFORMS")
-    if forced:
-        # the axon site hook overrides jax_platforms at import time; the
-        # post-import config.update is what actually makes forcing stick
-        jax.config.update("jax_platforms", forced)
     devs = jax.devices()
     log(f"backend: {devs[0].platform} x{len(devs)}")
     if devs[0].platform not in ("tpu",):

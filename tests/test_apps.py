@@ -83,6 +83,29 @@ class TestTrainMains:
         assert len(records) == 10
         assert sorted({r.label for r in records}) == [1.0, 2.0]
 
+    def test_inception_shard_pipeline(self, tmp_path):
+        # pack a tiny PNG tree, then drive the ImageNet2012-style shard
+        # pipeline: MT decode -> crop -> normalize -> batch -> prefetch
+        from bigdl_tpu.apps import seqfilegen
+        from bigdl_tpu.apps.inception import _shard_dataset
+        from PIL import Image
+        base = tmp_path / "imgs"
+        for ci, cls in enumerate(["cat", "dog"]):
+            d = base / "train" / cls
+            d.mkdir(parents=True)
+            for i in range(4):
+                Image.new("RGB", (16, 12), (ci * 100, i * 30, 5)).save(
+                    d / f"{i}.png")
+        out = str(tmp_path / "shards")
+        seqfilegen.main(["-f", str(base), "-o", out, "-b", "8"])
+        for train in (True, False):
+            ds = _shard_dataset(os.path.join(out, "train"), batch=4,
+                                train=train)
+            batches = list(ds.data(train=False))
+            assert len(batches) == 2
+            assert batches[0].data.shape == (4, 224, 224, 3)
+            assert set(np.asarray(batches[0].labels)) <= {1.0, 2.0}
+
     def test_imageclassifier_predicts(self, tmp_path, capsys, monkeypatch):
         from bigdl_tpu.apps import imageclassifier, modelvalidator
         from bigdl_tpu.utils import file_io

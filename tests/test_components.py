@@ -69,6 +69,28 @@ class TestShards:
         ds = ShardFolder.files(str(tmp_path / "d"))
         assert ds.size() == 5
 
+    def test_streaming_dataset(self, tmp_path):
+        prefix = str(tmp_path / "d" / "part")
+        with ShardWriter(prefix, records_per_shard=4) as w:
+            for i in range(10):
+                w.write(float(i % 3 + 1), bytes([i]))
+        ds = ShardFolder.stream(str(tmp_path / "d"), 0, 1)
+        assert ds.size() == 10
+        first = [r.data for r in ds.data(train=True)]
+        assert len(first) == 10
+        ds.shuffle()
+        again = [r.data for r in ds.data(train=True)]
+        assert sorted(again) == sorted(first)  # same records each epoch
+        # composes with transformers like any DataSet
+        from bigdl_tpu.dataset.base import Transformer
+
+        class _Len(Transformer):
+            def __call__(self, prev):
+                for r in prev:
+                    yield len(r.data)
+
+        assert list((ds >> _Len()).data(train=False)) == [1] * 10
+
     def test_native_scan_matches_python_reader(self, tmp_path, monkeypatch):
         from bigdl_tpu import native
         from bigdl_tpu.dataset import shards as sh
