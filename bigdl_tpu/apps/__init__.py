@@ -15,8 +15,10 @@ reference's Perf mains use constant|random synthetic input the same way), so
 each path is drivable without datasets.
 """
 
-from bigdl_tpu.apps.common import ensure_platform
+from bigdl_tpu.utils.platform import ensure_platform
 
 # Honor a user-set JAX_PLATFORMS for every `python -m bigdl_tpu.apps.*`
 # entry point (site hooks can override the env var at interpreter start).
+# NOTE: this only imports jax when JAX_PLATFORMS is set — jax-free tools
+# (seqfilegen) stay jax-free otherwise.
 ensure_platform()

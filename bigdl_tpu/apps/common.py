@@ -13,25 +13,7 @@ from bigdl_tpu.optim import (Optimizer, SGD, Top1Accuracy, Top5Accuracy,
 from bigdl_tpu.utils.logger_filter import redirect_logs
 
 
-def ensure_platform() -> None:
-    """Make a user-set ``JAX_PLATFORMS`` env var actually stick.
-
-    Some site hooks (e.g. a TPU plugin's sitecustomize) override the jax
-    platform config at import time, after which the env var alone is
-    ignored; re-asserting it via ``jax.config`` post-import is what makes
-    ``JAX_PLATFORMS=cpu python -m bigdl_tpu.apps.lenet ...`` behave as
-    documented. No-op when the env var is unset or a backend is already
-    initialized."""
-    import os
-    forced = os.environ.get("JAX_PLATFORMS")
-    if not forced:
-        return
-    try:
-        import jax
-        jax.config.update("jax_platforms", forced)
-    except Exception:
-        logging.getLogger("bigdl_tpu").debug(
-            "could not re-assert JAX_PLATFORMS=%s", forced, exc_info=True)
+from bigdl_tpu.utils.platform import ensure_platform  # noqa: F401 (re-export)
 
 
 def train_parser(prog: str, default_batch: int = 128,

@@ -70,14 +70,11 @@ def main(argv=None) -> None:
 
     rng = np.random.RandomState(0)
     n_records = args.batchSize * 2  # endless shuffled iterator re-serves them
-    if int_vocab:  # 1-based token indices (LookupTable input)
-        if args.dataType == "constant":
-            feats = [np.ones(shape, np.float32) for _ in range(n_records)]
-        else:
-            feats = [rng.randint(1, int_vocab + 1, shape).astype(np.float32)
-                     for _ in range(n_records)]
-    elif args.dataType == "constant":
+    if args.dataType == "constant":
         feats = [np.ones(shape, np.float32) for _ in range(n_records)]
+    elif int_vocab:  # 1-based token indices (LookupTable input)
+        feats = [rng.randint(1, int_vocab + 1, shape).astype(np.float32)
+                 for _ in range(n_records)]
     else:
         feats = [rng.randn(*shape).astype(np.float32)
                  for _ in range(n_records)]

@@ -101,6 +101,23 @@ def _native_scan(path: str):
     return buf, [(offs[i], lens[i]) for i in range(n)]
 
 
+def _count_records(path: str) -> int:
+    """Record count via framing walk only (no payload CRC, no decode)."""
+    from bigdl_tpu import native
+    dll = native.load()
+    if dll is not None:
+        import ctypes
+        with open(path, "rb") as f:
+            buf = f.read()
+        worst = len(buf) // 16 + 1
+        offs = (ctypes.c_uint64 * worst)()
+        lens = (ctypes.c_uint64 * worst)()
+        n = dll.bt_shard_scan(buf, len(buf), offs, lens, worst, 0)
+        if n >= 0:
+            return int(n)
+    return sum(1 for _ in FileReader.read_records(path, validate_crc=False))
+
+
 def read_shard(path: str) -> Iterator[ByteRecord]:
     scanned = _native_scan(path)
     if scanned is not None:
@@ -163,8 +180,8 @@ class StreamingShardDataSet(AbstractDataSet):
     """
 
     def __init__(self, paths: Sequence[str]):
-        if not paths:
-            raise ValueError("no shard files given")
+        # an empty host slice (fewer shards than hosts) is valid: that
+        # process streams nothing, mirroring files()'s empty DataSet
         self._paths = list(paths)
         self._order = list(range(len(self._paths)))
         self._size: Optional[int] = None
@@ -172,15 +189,22 @@ class StreamingShardDataSet(AbstractDataSet):
 
     def data(self, train: bool) -> Iterator[ByteRecord]:
         from bigdl_tpu.utils.rng import RandomGenerator
-        for i in self._order:
+        # eval iteration stays in deterministic disk order regardless of
+        # shuffle() calls (LocalDataSet contract: predictions must match
+        # back to record order)
+        order = self._order if train else range(len(self._paths))
+        for i in order:
             records = list(read_shard(self._paths[i]))
-            if self._shuffled:
+            if train and self._shuffled:
                 RandomGenerator.RNG().shuffle(records)
             yield from records
 
     def size(self) -> int:
         if self._size is None:
-            self._size = sum(1 for p in self._paths for _ in read_shard(p))
+            # frame-count only: skip payload CRC + decode (a full
+            # read_shard pre-pass would stream the whole corpus once just
+            # for the epoch-size log line)
+            self._size = sum(_count_records(p) for p in self._paths)
         return self._size
 
     def shuffle(self) -> None:

@@ -32,17 +32,35 @@ def evaluate_batches(fwd: Callable, params, buffers,
                      v_methods: Sequence[ValidationMethod],
                      ) -> Tuple[List[Optional[ValidationResult]], int]:
     """Run ``fwd(params, buffers, data)`` over batches, merging each method's
-    ValidationResults. Returns (results, record_count)."""
+    ValidationResults. Returns (results, record_count).
+
+    A tail batch smaller than the first-seen batch is zero-padded up to the
+    static shape before ``fwd`` (XLA would otherwise compile a second
+    program for the one odd shape) and the padded rows are sliced off the
+    output before scoring — every record is evaluated, none double-counted.
+    """
     results: List[Optional[ValidationResult]] = [None] * len(v_methods)
     count = 0
+    full_bs: Optional[int] = None
+    sliceable: Optional[bool] = None  # learned from the first (full) batch
     for item in batches:
         batch = _as_minibatch(item)
-        out = fwd(params, buffers, jnp.asarray(batch.data))
+        n = batch.size()
+        data = jnp.asarray(batch.data)
+        if full_bs is None:
+            full_bs = n
+        if n < full_bs and sliceable:
+            pad = jnp.zeros((full_bs - n, *data.shape[1:]), data.dtype)
+            out = fwd(params, buffers, jnp.concatenate([data, pad]))[:n]
+        else:  # full batch, or structured output needing the exact shape
+            out = fwd(params, buffers, data)
+            if sliceable is None:
+                sliceable = isinstance(out, jax.Array)
         labels = jnp.asarray(batch.labels)
         for i, m in enumerate(v_methods):
             r = m.apply(out, labels)
             results[i] = r if results[i] is None else results[i] + r
-        count += batch.size()
+        count += n
     return results, count
 
 

@@ -102,12 +102,15 @@ def pipeline_spec_tree(stack: PipelineStack, axis: str = PIPELINE_AXIS):
 
 def gpipe_apply(stack: PipelineStack, local_params, x,
                 n_micro: int, axis_name: str = PIPELINE_AXIS,
-                training: bool = False):
+                training: bool = False, remat: bool = False):
     """GPipe forward INSIDE shard_map.
 
     local_params: this stage's slice, leaves (depth/P, ...).
     x: full batch (replicated over the pipe axis); batch size must divide
     by ``n_micro``. Returns the model output, replicated over the axis.
+    ``remat=True`` recomputes each stage's internals in the backward
+    (``jax.checkpoint``), bounding live activation memory at one microbatch
+    boundary per schedule slot — the standard deep-pipeline recipe.
     """
     p = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -117,6 +120,9 @@ def gpipe_apply(stack: PipelineStack, local_params, x,
 
     def stage_fn(h):
         return stack.scan_apply(local_params, h, training=training)
+
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
     perm = [(i, (i + 1) % p) for i in range(p)]
     state = jnp.zeros_like(mbs[0])
@@ -143,7 +149,7 @@ def gpipe_apply(stack: PipelineStack, local_params, x,
 
 def gpipe_loss_fn(stack: PipelineStack, criterion, mesh,
                   n_micro: int, axis_name: str = PIPELINE_AXIS,
-                  head: Optional[Callable] = None):
+                  head: Optional[Callable] = None, remat: bool = False):
     """(stacked_params, head_params, x, labels) -> scalar loss, jittable.
 
     Wraps the schedule in shard_map over ``mesh``; ``head`` is an optional
@@ -157,7 +163,7 @@ def gpipe_loss_fn(stack: PipelineStack, criterion, mesh,
 
     def local_fn(stacked, head_params, x, labels):
         feats = gpipe_apply(stack, stacked, x, n_micro, axis_name,
-                            training=True)
+                            training=True, remat=remat)
         logits = head(head_params, feats) if head is not None else feats
         loss = criterion.apply(logits, labels).astype(jnp.float32)
         return loss

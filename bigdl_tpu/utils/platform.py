@@ -1,0 +1,28 @@
+"""Platform-forcing helper, dependency-light by design (jax-free tools like
+the shard packer import it via ``bigdl_tpu.apps`` without paying a jax
+import)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+def ensure_platform() -> None:
+    """Make a user-set ``JAX_PLATFORMS`` env var actually stick.
+
+    Some site hooks (e.g. a TPU plugin's sitecustomize) override the jax
+    platform config at import time, after which the env var alone is
+    ignored; re-asserting it via ``jax.config`` post-import is what makes
+    ``JAX_PLATFORMS=cpu python -m bigdl_tpu.apps.lenet ...`` behave as
+    documented. No-op when the env var is unset; never imports jax in that
+    case."""
+    forced = os.environ.get("JAX_PLATFORMS")
+    if not forced:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", forced)
+    except Exception:
+        logging.getLogger("bigdl_tpu").debug(
+            "could not re-assert JAX_PLATFORMS=%s", forced, exc_info=True)

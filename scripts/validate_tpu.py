@@ -134,7 +134,7 @@ def main():
                           os.path.join(os.path.dirname(
                               os.path.dirname(os.path.abspath(__file__))),
                               ".jax_cache"))
-    from bigdl_tpu.apps.common import ensure_platform
+    from bigdl_tpu.utils.platform import ensure_platform
     ensure_platform()
     import jax
     devs = jax.devices()

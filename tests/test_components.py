@@ -81,6 +81,11 @@ class TestShards:
         ds.shuffle()
         again = [r.data for r in ds.data(train=True)]
         assert sorted(again) == sorted(first)  # same records each epoch
+        # eval order stays deterministic disk order even after shuffle()
+        assert [r.data for r in ds.data(train=False)] == first
+        # a host whose round-robin slice is empty streams nothing (no crash)
+        empty = ShardFolder.stream(str(tmp_path / "d"), 7, 8)
+        assert empty.size() == 0 and list(empty.data(train=True)) == []
         # composes with transformers like any DataSet
         from bigdl_tpu.dataset.base import Transformer
 

@@ -146,3 +146,34 @@ class TestTriggers:
         s = sgd.init_state(x)
         x2, _ = sgd.update(jnp.zeros(1), s, x)
         np.testing.assert_allclose(float(x2[0]), 1.0 - 0.1 * 0.5)
+
+
+class TestEvaluatorTailPadding:
+    def test_tail_batch_padded_to_static_shape(self):
+        # 10 records, batch 4 -> 4,4,2: the odd tail must be padded to the
+        # static shape (one compiled program) and still score every record
+        from bigdl_tpu.dataset.base import (DataSet, Sample, SampleToBatch)
+        from bigdl_tpu.optim.evaluator import evaluate_batches
+        from bigdl_tpu.optim.validation import Top1Accuracy
+
+        rng = np.random.RandomState(3)
+        feats = rng.randn(10, 4).astype(np.float32)
+        labels = (rng.randint(0, 2, 10) + 1).astype(np.float32)
+        samples = [Sample(f, l) for f, l in zip(feats, labels)]
+        ds = DataSet.array(samples) >> SampleToBatch(4, drop_remainder=False)
+
+        w = rng.randn(4, 2).astype(np.float32)
+        shapes = []
+
+        def fwd(params, buffers, x):
+            shapes.append(x.shape)
+            return jnp.asarray(x) @ params
+
+        results, count = evaluate_batches(fwd, w, {}, ds.data(train=False),
+                                          [Top1Accuracy()])
+        assert count == 10
+        assert shapes == [(4, 4)] * 3  # tail padded, single static shape
+        # exact agreement with the all-at-once score
+        want = float(np.mean((feats @ w).argmax(1) + 1 == labels))
+        got = results[0].result()[0]
+        np.testing.assert_allclose(got, want)

@@ -76,6 +76,23 @@ def test_gpipe_grads_match_sequential():
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_gpipe_remat_grads_identical():
+    # jax.checkpoint trades FLOPs for memory; gradients must be unchanged
+    mesh = MeshTopology(pipeline=4).build()
+    stack = PipelineStack(_block, depth=4)
+    crit = nn.MSECriterion()
+    x, y = _rand(4, 5, 16), _rand(4, 5, 16)
+    params = stack.parameter_tree()
+    g_plain = jax.jit(jax.grad(lambda p: gpipe_loss_fn(
+        stack, crit, mesh, n_micro=4)(p, None, x, y)))(params)
+    g_remat = jax.jit(jax.grad(lambda p: gpipe_loss_fn(
+        stack, crit, mesh, n_micro=4, remat=True)(p, None, x, y)))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_plain),
+                    jax.tree_util.tree_leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_gpipe_with_head_and_sharded_params():
     # Train-shaped usage: params placed sharded over pipe axis, classifier
     # head on top, one SGD step decreases the loss.
