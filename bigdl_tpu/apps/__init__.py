@@ -19,6 +19,6 @@ from bigdl_tpu.utils.platform import ensure_platform
 
 # Honor a user-set JAX_PLATFORMS for every `python -m bigdl_tpu.apps.*`
 # entry point (site hooks can override the env var at interpreter start).
-# NOTE: this only imports jax when JAX_PLATFORMS is set — jax-free tools
-# (seqfilegen) stay jax-free otherwise.
+# (jax is already imported by the bigdl_tpu package __init__ at this point;
+# the helper only re-asserts the platform config.)
 ensure_platform()

@@ -102,17 +102,14 @@ def _native_scan(path: str):
 
 
 def _count_records(path: str) -> int:
-    """Record count via framing walk only (no payload CRC, no decode)."""
+    """Record count via framing walk only (no payload CRC, no decode, no
+    index allocation — ``bt_shard_count``)."""
     from bigdl_tpu import native
     dll = native.load()
     if dll is not None:
-        import ctypes
         with open(path, "rb") as f:
             buf = f.read()
-        worst = len(buf) // 16 + 1
-        offs = (ctypes.c_uint64 * worst)()
-        lens = (ctypes.c_uint64 * worst)()
-        n = dll.bt_shard_scan(buf, len(buf), offs, lens, worst, 0)
+        n = dll.bt_shard_count(buf, len(buf), 0)
         if n >= 0:
             return int(n)
     return sum(1 for _ in FileReader.read_records(path, validate_crc=False))

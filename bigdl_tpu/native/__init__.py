@@ -98,6 +98,9 @@ def _bind(path: str) -> ctypes.CDLL:
     dll.bt_shard_scan.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
                                   u64, u64, ctypes.c_size_t, ctypes.c_int]
     dll.bt_shard_scan.restype = ctypes.c_int64
+    dll.bt_shard_count.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                   ctypes.c_int]
+    dll.bt_shard_count.restype = ctypes.c_int64
     return dll
 
 

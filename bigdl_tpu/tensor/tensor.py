@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional, Sequence, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -382,6 +383,134 @@ class Tensor:
         self.data = self.data + alpha * jnp.outer(_promote(vec1),
                                                   _promote(vec2))
         return self
+
+    def bmm(self, a: "Tensor", b: "Tensor") -> "Tensor":
+        """self = batched a @ b over a leading batch dim (reference
+        ``baddbmm`` family with β=0, α=1)."""
+        self.data = jnp.matmul(_promote(a), _promote(b))
+        return self
+
+    def cinv(self) -> "Tensor":
+        """Elementwise reciprocal in place (reference ``TensorMath.inv``)."""
+        self.data = (1.0 / self.data).astype(self.data.dtype)
+        return self
+
+    def stride(self, dim: Optional[int] = None):
+        """Row-major element strides (reference ``Tensor.stride``); XLA
+        arrays are always logically contiguous, so strides derive from the
+        shape."""
+        shape = self.data.shape
+        strides = []
+        acc = 1
+        for s in reversed(shape):
+            strides.append(acc)
+            acc *= s
+        strides = tuple(reversed(strides))
+        if dim is None:
+            return strides
+        return strides[self._dim(dim)]
+
+    def uniform(self, a: float = 0.0, b: float = 1.0) -> "Tensor":
+        """Fill in place with U[a, b) draws from the framework RNG stream
+        (reference ``rand``/Torch ``uniform``)."""
+        from bigdl_tpu.utils.rng import RandomGenerator
+        draws = RandomGenerator.RNG().uniform(a, b, size=self.data.shape)
+        self.data = jnp.asarray(draws, self.data.dtype)
+        return self
+
+    def sort(self, dim: Optional[int] = None, descending: bool = False):
+        """(sorted values, 1-based indices) along ``dim`` (default: last),
+        reference ``TensorMath.topk``'s full-sort sibling."""
+        ax = self._dim(dim) if dim is not None else self.data.ndim - 1
+        idx = jnp.argsort(self.data, axis=ax)
+        if descending:
+            idx = jnp.flip(idx, axis=ax)
+        values = jnp.take_along_axis(self.data, idx, axis=ax)
+        return Tensor(values), Tensor((idx + 1).astype(jnp.int32))
+
+    def topk(self, k: int, dim: Optional[int] = None,
+             increase: bool = True):
+        """k smallest (``increase=True``, the reference default) or largest
+        values + 1-based indices along ``dim`` (reference
+        ``TensorMath.topk``)."""
+        ax = self._dim(dim) if dim is not None else self.data.ndim - 1
+        if not 1 <= k <= self.data.shape[ax]:
+            raise IndexError(f"k={k} out of range [1, {self.data.shape[ax]}]")
+        values, idx = self.sort(dim=(ax + 1), descending=not increase)
+        sl = [slice(None)] * self.data.ndim
+        sl[ax] = slice(0, k)
+        return Tensor(values.data[tuple(sl)]), \
+            Tensor(idx.data[tuple(sl)])
+
+    def kthvalue(self, k: int, dim: Optional[int] = None):
+        """k-th smallest value (+ 1-based index) along ``dim`` (reference
+        quickselect ``Util.kthLargest`` kin; here a sort slice)."""
+        values, idx = self.topk(k, dim=dim, increase=True)
+        ax = self._dim(dim) if dim is not None else self.data.ndim - 1
+        sl = [slice(None)] * self.data.ndim
+        sl[ax] = slice(k - 1, k)
+        return Tensor(values.data[tuple(sl)]), Tensor(idx.data[tuple(sl)])
+
+    def gather(self, dim: int, index) -> "Tensor":
+        """Gather along ``dim`` with 1-based index tensor (reference
+        ``Tensor.gather``)."""
+        ax = self._dim(dim)
+        idx = jnp.asarray(_promote(index)).astype(jnp.int32) - 1
+        return Tensor(jnp.take_along_axis(self.data, idx, axis=ax))
+
+    def scatter(self, dim: int, index, src) -> "Tensor":
+        """Scatter ``src`` along ``dim`` at 1-based ``index`` positions, in
+        place (reference ``Tensor.scatter``); stays on device."""
+        ax = self._dim(dim)
+        idx = jnp.asarray(_promote(index)).astype(jnp.int32) - 1
+        self.data = jnp.put_along_axis(
+            self.data, idx, jnp.asarray(_promote(src), self.data.dtype),
+            axis=ax, inplace=False)
+        return self
+
+    def split(self, size: int, dim: int = 1):
+        """List of Tensors of width ``size`` along 1-based ``dim`` (last
+        piece may be smaller), reference ``Tensor.split``."""
+        ax = self._dim(dim)
+        n = self.data.shape[ax]
+        out = []
+        for start in range(0, n, size):
+            sl = [slice(None)] * self.data.ndim
+            sl[ax] = slice(start, min(start + size, n))
+            out.append(Tensor(self.data[tuple(sl)]))
+        return out
+
+    def chunk(self, n: int, dim: int = 1):
+        """Split into ``n`` near-equal pieces (reference ``Tensor.chunk``)."""
+        ax = self._dim(dim)
+        size = -(-self.data.shape[ax] // n)  # ceil
+        return self.split(size, dim)
+
+    def _conv2_like(self, kernel, conv_type: str, flip: bool) -> "Tensor":
+        k = jnp.asarray(_promote(kernel))
+        if self.data.ndim != 2 or k.ndim != 2:
+            raise ValueError("conv2/xcorr2 expect 2-d tensors")
+        if flip:  # convolution = correlation with the flipped kernel
+            k = jnp.flip(k, (0, 1))
+        if conv_type not in ("V", "F"):
+            raise ValueError("conv type must be 'V' (valid) or 'F' (full)")
+        pad = "VALID" if conv_type == "V" else \
+            [(k.shape[0] - 1,) * 2, (k.shape[1] - 1,) * 2]
+        out = jax.lax.conv_general_dilated(
+            self.data[None, None].astype(jnp.float32),
+            k[None, None].astype(jnp.float32),
+            window_strides=(1, 1), padding=pad)
+        return Tensor(out[0, 0].astype(self.data.dtype))
+
+    def conv2(self, kernel, conv_type: str = "V") -> "Tensor":
+        """2-D convolution, 'V'alid or 'F'ull (reference
+        ``TensorMath.conv2`` backed by ``DenseTensorConv.scala:23``; here a
+        1-channel ``lax.conv`` that XLA maps to the MXU)."""
+        return self._conv2_like(kernel, conv_type, flip=True)
+
+    def xcorr2(self, kernel, conv_type: str = "V") -> "Tensor":
+        """2-D cross-correlation (reference ``TensorMath.xcorr2``)."""
+        return self._conv2_like(kernel, conv_type, flip=False)
 
     # ------------------------------------------------------------ operators
     def __add__(self, other):

@@ -1,6 +1,6 @@
-"""Platform-forcing helper, dependency-light by design (jax-free tools like
-the shard packer import it via ``bigdl_tpu.apps`` without paying a jax
-import)."""
+"""Platform-forcing helper. Kept in its own module so importing it pulls in
+nothing beyond the package itself (the ``bigdl_tpu`` package __init__ already
+imports jax; this module adds no further weight)."""
 
 from __future__ import annotations
 

@@ -182,3 +182,90 @@ class TestStorageAndInterop:
     def test_apply1(self):
         t = Tensor([1.0, 2.0]).apply1(lambda x: x * 10)
         assert np.allclose(t.numpy(), [10, 20])
+
+
+class TestTensorMathExtras:
+    """TensorMath parity additions (reference ``TensorMath.scala:28``,
+    ``DenseTensorConv.scala:23``): topk/sort/gather/scatter/split/chunk/
+    stride/conv2/xcorr2 against numpy/scipy-style oracles."""
+
+    def test_stride(self):
+        t = Tensor(np.zeros((3, 4, 5), np.float32))
+        assert t.stride() == (20, 5, 1)
+        assert t.stride(1) == 20 and t.stride(3) == 1
+
+    def test_cinv_bmm(self):
+        t = Tensor(np.asarray([[2.0, 4.0]], np.float32))
+        np.testing.assert_allclose(np.asarray(t.cinv().data), [[0.5, 0.25]])
+        a = np.random.RandomState(0).randn(3, 2, 4).astype(np.float32)
+        b = np.random.RandomState(1).randn(3, 4, 5).astype(np.float32)
+        out = Tensor(1).bmm(Tensor(a), Tensor(b))
+        np.testing.assert_allclose(np.asarray(out.data), a @ b, rtol=1e-5)
+
+    def test_sort_topk_kthvalue(self):
+        x = np.asarray([[3.0, 1.0, 2.0], [9.0, 7.0, 8.0]], np.float32)
+        t = Tensor(x)
+        v, i = t.sort(dim=2)
+        np.testing.assert_allclose(np.asarray(v.data), np.sort(x, axis=1))
+        np.testing.assert_allclose(np.asarray(i.data),
+                                   np.argsort(x, axis=1) + 1)
+        v, i = t.topk(2, dim=2, increase=True)  # 2 smallest, reference default
+        np.testing.assert_allclose(np.asarray(v.data), [[1, 2], [7, 8]])
+        np.testing.assert_allclose(np.asarray(i.data), [[2, 3], [2, 3]])
+        v, i = t.topk(1, dim=2, increase=False)  # largest
+        np.testing.assert_allclose(np.asarray(v.data), [[3], [9]])
+        v, i = t.kthvalue(2, dim=2)
+        np.testing.assert_allclose(np.asarray(v.data), [[2], [8]])
+
+    def test_gather_scatter_roundtrip(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        t = Tensor(x)
+        idx = np.asarray([[1, 2, 3, 4], [4, 3, 2, 1], [2, 2, 2, 2]])
+        g = t.gather(2, Tensor(idx.astype(np.float32)))
+        want = np.take_along_axis(x, idx - 1, axis=1)
+        np.testing.assert_allclose(np.asarray(g.data), want)
+        s = Tensor(np.zeros((3, 4), np.float32))
+        s.scatter(2, Tensor(idx.astype(np.float32)), g)
+        got = np.asarray(s.data)
+        np.testing.assert_allclose(
+            np.take_along_axis(got, idx - 1, axis=1), want)
+
+    def test_split_chunk(self):
+        t = Tensor(np.arange(10, dtype=np.float32)[None].repeat(2, 0))
+        parts = t.split(4, dim=2)
+        assert [p.size(2) for p in parts] == [4, 4, 2]
+        chunks = t.chunk(3, dim=2)
+        assert sum(c.size(2) for c in chunks) == 10
+
+    def test_uniform_fill(self):
+        from bigdl_tpu.utils.rng import manual_seed
+        manual_seed(5)
+        t = Tensor(np.zeros((100,), np.float32)).uniform(2.0, 3.0)
+        vals = np.asarray(t.data)
+        assert vals.min() >= 2.0 and vals.max() < 3.0 and vals.std() > 0.1
+
+    def test_conv2_xcorr2_valid_full(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(6, 7).astype(np.float32)
+        k = rng.randn(3, 3).astype(np.float32)
+
+        def ref_xcorr_valid(x, k):
+            h = x.shape[0] - k.shape[0] + 1
+            w = x.shape[1] - k.shape[1] + 1
+            out = np.zeros((h, w), np.float32)
+            for i in range(h):
+                for j in range(w):
+                    out[i, j] = np.sum(x[i:i + 3, j:j + 3] * k)
+            return out
+
+        t = Tensor(x)
+        np.testing.assert_allclose(np.asarray(t.xcorr2(k).data),
+                                   ref_xcorr_valid(x, k), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(t.conv2(k).data),
+                                   ref_xcorr_valid(x, k[::-1, ::-1]),
+                                   rtol=1e-4, atol=1e-5)
+        full = t.conv2(k, "F")
+        assert full.size() == (8, 9)
+        # full conv corner: out[0,0] = x[0,0] * k[0,0] (flip semantics)
+        np.testing.assert_allclose(full[1, 1], x[0, 0] * k[0, 0], rtol=1e-4)
