@@ -102,17 +102,26 @@ def _native_scan(path: str):
 
 
 def _count_records(path: str) -> int:
-    """Record count via framing walk only (no payload CRC, no decode, no
-    index allocation — ``bt_shard_count``)."""
-    from bigdl_tpu import native
-    dll = native.load()
-    if dll is not None:
-        with open(path, "rb") as f:
-            buf = f.read()
-        n = dll.bt_shard_count(buf, len(buf), 0)
-        if n >= 0:
-            return int(n)
-    return sum(1 for _ in FileReader.read_records(path, validate_crc=False))
+    """Record count via a seek-based framing walk: reads 12 bytes per record
+    and seeks over payloads — O(records) IO, near-zero resident memory (a
+    full-file read just to count frames would double-buffer multi-GB
+    shards)."""
+    total = os.path.getsize(path)
+    count = 0
+    with open(path, "rb") as f:
+        pos = 0
+        while total - pos >= 12:
+            header = f.read(12)
+            if len(header) < 12:
+                break
+            (length,) = struct.unpack_from("<Q", header)
+            body = pos + 12
+            if length > total - body or total - body - length < 4:
+                break  # truncated tail, same semantics as read_shard
+            count += 1
+            pos = body + length + 4
+            f.seek(pos)
+    return count
 
 
 def read_shard(path: str) -> Iterator[ByteRecord]:

@@ -98,9 +98,6 @@ def _bind(path: str) -> ctypes.CDLL:
     dll.bt_shard_scan.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
                                   u64, u64, ctypes.c_size_t, ctypes.c_int]
     dll.bt_shard_scan.restype = ctypes.c_int64
-    dll.bt_shard_count.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
-                                   ctypes.c_int]
-    dll.bt_shard_count.restype = ctypes.c_int64
     return dll
 
 
@@ -121,15 +118,30 @@ def load(force_rebuild: bool = False) -> Optional[ctypes.CDLL]:
                 if os.path.exists(cand) and not _stale(cand):
                     path = cand
                     break
+        compiled_fresh = False
         if path is None:
             path = _compile()
+            compiled_fresh = True
         if path is not None:
             try:
                 lib = _bind(path)
                 logger.info("native library loaded from %s", path)
-            except OSError as e:  # pragma: no cover
-                logger.warning("native library load failed: %s", e)
+            except (OSError, AttributeError) as e:
+                # AttributeError = a cached .so that predates a newly added
+                # symbol but passed the mtime staleness check; rebuild once
+                # rather than crashing every native caller.
                 lib = None
+                if not compiled_fresh:
+                    logger.info("native library at %s is stale/unloadable "
+                                "(%s); rebuilding", path, e)
+                    path = _compile()
+                    if path is not None:
+                        try:
+                            lib = _bind(path)
+                        except (OSError, AttributeError) as e2:
+                            logger.warning("native rebuild failed: %s", e2)
+                else:
+                    logger.warning("native library load failed: %s", e)
         return lib
 
 

@@ -90,21 +90,4 @@ int64_t bt_shard_scan(const uint8_t* buf, size_t n, uint64_t* offsets,
   return static_cast<int64_t>(count);
 }
 
-// Count-only framing walk: no index arrays, no payload CRC — the cheap
-// size() path for streaming datasets.  Same truncated-tail semantics as
-// bt_shard_scan; returns -1 on a corrupt header when validate != 0.
-int64_t bt_shard_count(const uint8_t* buf, size_t n, int validate) {
-  size_t pos = 0, count = 0;
-  while (n - pos >= 12) {
-    uint64_t len = load_u64(buf + pos);
-    if (validate && masked(bt_crc32c(buf + pos, 8)) != load_u32(buf + pos + 8))
-      return -1;
-    size_t body = pos + 12;
-    if (len > n - body || n - body - len < 4) break;
-    ++count;
-    pos = body + len + 4;
-  }
-  return static_cast<int64_t>(count);
-}
-
 }  // extern "C"

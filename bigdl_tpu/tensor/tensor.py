@@ -451,20 +451,29 @@ class Tensor:
         sl[ax] = slice(k - 1, k)
         return Tensor(values.data[tuple(sl)]), Tensor(idx.data[tuple(sl)])
 
+    def _checked_index(self, index, ax: int) -> jnp.ndarray:
+        """Validate a 1-based index tensor — jnp would silently wrap/clip
+        out-of-range indices (same rationale as ``_index``)."""
+        idx = np.asarray(_promote(index)).astype(np.int64)
+        if idx.size and (idx.min() < 1 or idx.max() > self.data.shape[ax]):
+            raise IndexError(f"index out of range [1, {self.data.shape[ax]}]"
+                             " (1-based)")
+        return jnp.asarray(idx - 1, jnp.int32)
+
     def gather(self, dim: int, index) -> "Tensor":
         """Gather along ``dim`` with 1-based index tensor (reference
         ``Tensor.gather``)."""
         ax = self._dim(dim)
-        idx = jnp.asarray(_promote(index)).astype(jnp.int32) - 1
-        return Tensor(jnp.take_along_axis(self.data, idx, axis=ax))
+        return Tensor(jnp.take_along_axis(
+            self.data, self._checked_index(index, ax), axis=ax))
 
     def scatter(self, dim: int, index, src) -> "Tensor":
         """Scatter ``src`` along ``dim`` at 1-based ``index`` positions, in
-        place (reference ``Tensor.scatter``); stays on device."""
+        place (reference ``Tensor.scatter``)."""
         ax = self._dim(dim)
-        idx = jnp.asarray(_promote(index)).astype(jnp.int32) - 1
         self.data = jnp.put_along_axis(
-            self.data, idx, jnp.asarray(_promote(src), self.data.dtype),
+            self.data, self._checked_index(index, ax),
+            jnp.asarray(_promote(src), self.data.dtype),
             axis=ax, inplace=False)
         return self
 

@@ -269,3 +269,14 @@ class TestTensorMathExtras:
         assert full.size() == (8, 9)
         # full conv corner: out[0,0] = x[0,0] * k[0,0] (flip semantics)
         np.testing.assert_allclose(full[1, 1], x[0, 0] * k[0, 0], rtol=1e-4)
+
+    def test_gather_scatter_validate_indices(self):
+        t = Tensor(np.asarray([[1.0, 2.0, 3.0]], np.float32))
+        import pytest
+        with pytest.raises(IndexError):
+            t.gather(2, Tensor(np.asarray([[0.0]], np.float32)))
+        with pytest.raises(IndexError):
+            t.gather(2, Tensor(np.asarray([[4.0]], np.float32)))
+        with pytest.raises(IndexError):
+            t.scatter(2, Tensor(np.asarray([[0.0]], np.float32)),
+                      Tensor(np.asarray([[9.0]], np.float32)))
