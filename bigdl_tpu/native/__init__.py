@@ -65,10 +65,16 @@ def _stale(path: str) -> bool:
     return any(os.path.getmtime(s) > built for s in _sources())
 
 
-def _compile() -> Optional[str]:
+def _compile(unique: bool = False) -> Optional[str]:
+    """Build the shared library; ``unique=True`` writes to a fresh filename
+    (dlopen caches by pathname — rebuilding over a path this process already
+    loaded would hand back the stale mapping)."""
     cxx = os.environ.get("CXX", "g++")
     for out_path in _candidate_paths():
         out_dir = os.path.dirname(out_path)
+        if unique:
+            out_path = os.path.join(
+                out_dir, f"libbigdl_tpu_native-{os.getpid()}.so")
         try:
             os.makedirs(out_dir, exist_ok=True)
             cmd = [cxx, "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
@@ -132,9 +138,11 @@ def load(force_rebuild: bool = False) -> Optional[ctypes.CDLL]:
                 # rather than crashing every native caller.
                 lib = None
                 if not compiled_fresh:
+                    # unique filename: dlopen already cached the stale
+                    # mapping under the original path for this process
                     logger.info("native library at %s is stale/unloadable "
                                 "(%s); rebuilding", path, e)
-                    path = _compile()
+                    path = _compile(unique=True)
                     if path is not None:
                         try:
                             lib = _bind(path)

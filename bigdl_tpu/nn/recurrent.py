@@ -38,9 +38,31 @@ class Cell(Module):
     hidden_size: int
 
     def step(self, x_t, state):
-        raise NotImplementedError
+        """Single-step forward; default composes the split protocol below
+        (the projection matmul broadcasts over any leading dims, so the
+        same expression serves (N, F) steps and (T, N, F) sequences)."""
+        px = self.project_input(x_t)
+        if px is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} must implement step() or the "
+                "project_input/step_projected pair")
+        return self.step_projected(px, state)
 
     def initial_state(self, batch_size: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    # Optional split protocol: when the input contribution to the gates is
+    # state-independent, Recurrent hoists it OUT of the scan — one
+    # (T*N, F)x(F, G) MXU matmul over the whole sequence instead of T
+    # per-step slivers (cuDNN does the same; the MXU strongly prefers the
+    # single big dot). Cells overriding project_input must pair it with
+    # step_projected.
+    def project_input(self, xs):
+        """xs (T, N, F) -> per-step projections (T, N, G), or None when the
+        cell has no hoistable input path."""
+        return None
+
+    def step_projected(self, px_t, state):
         raise NotImplementedError
 
     def update_output(self, input):
@@ -61,8 +83,11 @@ class RnnCell(Cell):
         self.register_parameter("w_hh", init.default_init((hidden_size, hidden_size), hidden_size))
         self.register_parameter("bias", init.default_init((hidden_size,), input_size))
 
-    def step(self, x_t, h):
-        h_new = self.activation(x_t @ self.w_ih.T + h @ self.w_hh.T + self.bias)
+    def project_input(self, xs):
+        return xs @ self.w_ih.T + self.bias
+
+    def step_projected(self, px_t, h):
+        h_new = self.activation(px_t + h @ self.w_hh.T)
         return h_new, h_new
 
     def initial_state(self, batch_size, dtype=jnp.float32):
@@ -82,9 +107,12 @@ class LSTM(Cell):
         self.register_parameter("w_hh", init.default_init((h4, hidden_size), hidden_size))
         self.register_parameter("bias", init.default_init((h4,), input_size))
 
-    def step(self, x_t, state):
+    def project_input(self, xs):
+        return xs @ self.w_ih.T + self.bias
+
+    def step_projected(self, px_t, state):
         h, c = state
-        gates = x_t @ self.w_ih.T + h @ self.w_hh.T + self.bias
+        gates = px_t + h @ self.w_hh.T
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         i = jax.nn.sigmoid(i)
         f = jax.nn.sigmoid(f + self.forget_bias)
@@ -114,9 +142,12 @@ class LSTMPeephole(Cell):
         self.register_parameter("p_f", init.default_init((hidden_size,), hidden_size))
         self.register_parameter("p_o", init.default_init((hidden_size,), hidden_size))
 
-    def step(self, x_t, state):
+    def project_input(self, xs):
+        return xs @ self.w_ih.T + self.bias
+
+    def step_projected(self, px_t, state):
         h, c = state
-        gates = x_t @ self.w_ih.T + h @ self.w_hh.T + self.bias
+        gates = px_t + h @ self.w_hh.T
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         i = jax.nn.sigmoid(i + self.p_i * c)
         f = jax.nn.sigmoid(f + self.p_f * c)
@@ -143,10 +174,12 @@ class GRU(Cell):
         self.register_parameter("bias_ih", init.default_init((h3,), input_size))
         self.register_parameter("bias_hh", init.default_init((h3,), hidden_size))
 
-    def step(self, x_t, h):
-        gi = x_t @ self.w_ih.T + self.bias_ih
+    def project_input(self, xs):
+        return xs @ self.w_ih.T + self.bias_ih
+
+    def step_projected(self, px_t, h):
         gh = h @ self.w_hh.T + self.bias_hh
-        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        i_r, i_z, i_n = jnp.split(px_t, 3, axis=-1)
         h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
         r = jax.nn.sigmoid(i_r + h_r)
         z = jax.nn.sigmoid(i_z + h_z)
@@ -182,11 +215,21 @@ class Recurrent(Module):
         if self.reverse:
             xs = jnp.flip(xs, axis=0)
 
-        def body(state, x_t):
-            out_t, new_state = self.cell.step(x_t, state)
-            return new_state, out_t
+        px = self.cell.project_input(xs)
+        if px is not None:
+            # input projection hoisted: the scan body is only the (small)
+            # recurrent matmul + gate nonlinearity
+            def body(state, px_t):
+                out_t, new_state = self.cell.step_projected(px_t, state)
+                return new_state, out_t
 
-        _, outs = jax.lax.scan(body, state0, xs)
+            _, outs = jax.lax.scan(body, state0, px)
+        else:
+            def body(state, x_t):
+                out_t, new_state = self.cell.step(x_t, state)
+                return new_state, out_t
+
+            _, outs = jax.lax.scan(body, state0, xs)
         if self.reverse:
             outs = jnp.flip(outs, axis=0)
         return jnp.swapaxes(outs, 0, 1)  # (N, T, H)
