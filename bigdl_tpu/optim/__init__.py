@@ -13,3 +13,6 @@ from bigdl_tpu.optim.validation import (
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer
 from bigdl_tpu.optim.evaluator import Evaluator, Predictor
+from bigdl_tpu.optim.validator import (Validator, LocalValidator,
+                                       DistriValidator, calc_accuracy,
+                                       calc_top5_accuracy)

@@ -1,0 +1,79 @@
+"""Deprecated standalone validation drivers (reference
+``optim/Validator.scala:63``: ``Validator(model, dataset)`` factory building
+``LocalValidator``/``DistriValidator``; deprecated in 0.2.0 in favor of
+``model.evaluate``) and the legacy accuracy helpers
+(``optim/EvaluateMethods.scala``). Kept for API parity; both delegate to the
+one batch-eval loop in ``optim.evaluator``.
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.base import AbstractDataSet
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.evaluator import Evaluator
+from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+
+class Validator:
+    """reference ``optim/Validator.scala``: abstract test driver with a
+    deprecated factory. The Local/Distri split collapses here — one jitted
+    forward serves both — but both names stay constructible."""
+
+    def __init__(self, model: Module, dataset: AbstractDataSet):
+        self.model = model
+        self.dataset = dataset
+
+    def test(self, v_methods: Sequence[ValidationMethod]
+             ) -> List[Tuple[ValidationResult, ValidationMethod]]:
+        return Evaluator(self.model).test(self.dataset, v_methods)
+
+    def __new__(cls, model, dataset, *a, **k):
+        if cls is Validator:
+            warnings.warn(
+                "Validator(model, dataset) is deprecated. Please use "
+                "model.evaluate instead", DeprecationWarning, stacklevel=2)
+            logger.warning("Validator(model, dataset) is deprecated. "
+                           "Please use model.evaluate instead")
+            target = (DistriValidator
+                      if isinstance(dataset, AbstractDataSet)
+                      and dataset.is_distributed() else LocalValidator)
+            return super().__new__(target)
+        return super().__new__(cls)
+
+
+class LocalValidator(Validator):
+    """reference ``optim/LocalValidator.scala``."""
+
+
+class DistriValidator(Validator):
+    """reference ``optim/DistriValidator.scala``."""
+
+
+def calc_accuracy(output, target) -> Tuple[int, int]:
+    """(correct, count) top-1 (reference ``EvaluateMethods.calcAccuracy``;
+    1-based labels)."""
+    out = np.asarray(output)
+    tgt = np.asarray(target).ravel()
+    if out.ndim == 1:
+        out = out[None]
+    pred = out.argmax(axis=-1) + 1
+    return int((pred == tgt).sum()), int(out.shape[0])
+
+
+def calc_top5_accuracy(output, target) -> Tuple[int, int]:
+    """(correct, count) top-5 (reference ``EvaluateMethods.calcTop5Accuracy``)."""
+    out = np.asarray(output)
+    tgt = np.asarray(target).ravel()
+    if out.ndim == 1:
+        out = out[None]
+    top5 = np.argsort(-out, axis=-1)[:, :5] + 1
+    correct = sum(int(t in row) for t, row in zip(tgt, top5))
+    return correct, int(out.shape[0])

@@ -177,3 +177,41 @@ class TestEvaluatorTailPadding:
         want = float(np.mean((feats @ w).argmax(1) + 1 == labels))
         got = results[0].result()[0]
         np.testing.assert_allclose(got, want)
+
+
+class TestDeprecatedValidator:
+    def test_factory_and_test(self):
+        import warnings
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+        from bigdl_tpu.optim import (DistriValidator, LocalValidator,
+                                     Top1Accuracy, Validator)
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randn(4).astype(np.float32),
+                          np.float32(rng.randint(1, 3)))
+                   for _ in range(16)]
+        model = (nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax()))
+        ds = DataSet.array(samples) >> SampleToBatch(8)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            v = Validator(model, ds)
+            assert any("deprecated" in str(x.message) for x in w)
+        assert isinstance(v, LocalValidator)
+        (result, method), = v.test([Top1Accuracy()])
+        assert result.result()[1] == 16  # all records scored
+        dv = Validator(model, DataSet.array(samples, distributed=True)
+                       >> SampleToBatch(8))
+        assert isinstance(dv, DistriValidator)
+
+    def test_calc_accuracy_helpers(self):
+        from bigdl_tpu.optim import calc_accuracy, calc_top5_accuracy
+        out = np.asarray([[0.1, 0.9], [0.8, 0.2]], np.float32)
+        assert calc_accuracy(out, np.asarray([2.0, 1.0])) == (2, 2)
+        assert calc_accuracy(out, np.asarray([1.0, 1.0])) == (1, 2)
+        big = np.eye(8, dtype=np.float32)
+        assert calc_top5_accuracy(big, np.arange(1, 9, dtype=np.float32)) \
+            == (8, 8)
+        # label outside the top-5 set
+        assert calc_top5_accuracy(np.asarray([[9, 8, 7, 6, 5, 0.1, 0.2, 0.3]],
+                                             np.float32),
+                                  np.asarray([8.0])) == (0, 1)
