@@ -8,7 +8,6 @@ one batch-eval loop in ``optim.evaluator``.
 
 from __future__ import annotations
 
-import logging
 import warnings
 from typing import List, Sequence, Tuple
 
@@ -18,9 +17,6 @@ from bigdl_tpu.dataset.base import AbstractDataSet
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.optim.evaluator import Evaluator
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
-
-logger = logging.getLogger("bigdl_tpu.optim")
-
 
 class Validator:
     """reference ``optim/Validator.scala``: abstract test driver with a
@@ -40,8 +36,6 @@ class Validator:
             warnings.warn(
                 "Validator(model, dataset) is deprecated. Please use "
                 "model.evaluate instead", DeprecationWarning, stacklevel=2)
-            logger.warning("Validator(model, dataset) is deprecated. "
-                           "Please use model.evaluate instead")
             target = (DistriValidator
                       if isinstance(dataset, AbstractDataSet)
                       and dataset.is_distributed() else LocalValidator)
@@ -57,23 +51,26 @@ class DistriValidator(Validator):
     """reference ``optim/DistriValidator.scala``."""
 
 
+def _calc_topk(output, target, k: int) -> Tuple[int, int]:
+    from bigdl_tpu.optim.validation import _topk_correct
+    import jax.numpy as jnp
+    out = jnp.asarray(np.asarray(output))
+    tgt = jnp.asarray(np.asarray(target).ravel())
+    n = 1 if out.ndim == 1 else out.shape[0]
+    if tgt.shape[0] != n:
+        raise ValueError(f"output rows ({n}) != target length "
+                         f"({tgt.shape[0]})")
+    correct, count = _topk_correct(out, tgt, k)
+    return int(correct), int(count)
+
+
 def calc_accuracy(output, target) -> Tuple[int, int]:
     """(correct, count) top-1 (reference ``EvaluateMethods.calcAccuracy``;
-    1-based labels)."""
-    out = np.asarray(output)
-    tgt = np.asarray(target).ravel()
-    if out.ndim == 1:
-        out = out[None]
-    pred = out.argmax(axis=-1) + 1
-    return int((pred == tgt).sum()), int(out.shape[0])
+    1-based labels; delegates to the one top-k kernel in
+    ``optim.validation``)."""
+    return _calc_topk(output, target, 1)
 
 
 def calc_top5_accuracy(output, target) -> Tuple[int, int]:
     """(correct, count) top-5 (reference ``EvaluateMethods.calcTop5Accuracy``)."""
-    out = np.asarray(output)
-    tgt = np.asarray(target).ravel()
-    if out.ndim == 1:
-        out = out[None]
-    top5 = np.argsort(-out, axis=-1)[:, :5] + 1
-    correct = sum(int(t in row) for t, row in zip(tgt, top5))
-    return correct, int(out.shape[0])
+    return _calc_topk(output, target, 5)
