@@ -106,7 +106,10 @@ def _topk_correct(output, target, k: int):
         target = jnp.reshape(target, (1,))
     n, c = output.shape
     k = min(k, c)
-    idx = jnp.argsort(output, axis=1)[:, ::-1][:, :k]  # top-k, 0-based
+    # stable sort on negated scores: ties resolve to the LOWEST class index,
+    # matching np.argmax / torch.topk (a reversed ascending argsort would
+    # invert tie-breaking)
+    idx = jnp.argsort(-output, axis=1, stable=True)[:, :k]  # top-k, 0-based
     hits = jnp.any(idx == (target.astype(jnp.int32) - 1)[:, None], axis=1)
     return jnp.sum(hits), n
 

@@ -13,10 +13,13 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from bigdl_tpu.dataset.base import AbstractDataSet
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.optim.evaluator import Evaluator
-from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.optim.validation import (ValidationMethod, ValidationResult,
+                                        _topk_correct)
 
 class Validator:
     """reference ``optim/Validator.scala``: abstract test driver with a
@@ -52,8 +55,6 @@ class DistriValidator(Validator):
 
 
 def _calc_topk(output, target, k: int) -> Tuple[int, int]:
-    from bigdl_tpu.optim.validation import _topk_correct
-    import jax.numpy as jnp
     out = jnp.asarray(np.asarray(output))
     tgt = jnp.asarray(np.asarray(target).ravel())
     n = 1 if out.ndim == 1 else out.shape[0]

@@ -1,8 +1,9 @@
 """Worker process for the multi-host integration test (not a pytest file).
 
-Usage: python multihost_worker.py <pid> <nproc> <port> <outdir>
+Usage: python multihost_worker.py <pid> <nproc> <port> <outdir> [devs_per_proc]
 
-Each process gets 2 virtual CPU devices, joins the gloo coordinator, trains
+Each process gets ``devs_per_proc`` (default 2) virtual CPU devices, joins
+the gloo coordinator, trains
 LeNet under both sync modes on a deterministic synthetic set, and process 0
 saves the final parameters for the parent test to compare against a
 single-process run (reference: ``$T/optim/DistriOptimizerSpec.scala:40-42``
@@ -18,8 +19,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     pid, nproc, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
                                 sys.argv[3], sys.argv[4])
+    devs_per_proc = int(sys.argv[5]) if len(sys.argv) > 5 else 2
     os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devs_per_proc}")
     os.environ["BIGDL_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
     os.environ["BIGDL_NUM_PROCESSES"] = str(nproc)
     os.environ["BIGDL_PROCESS_ID"] = str(pid)

@@ -2,7 +2,8 @@
 capability (``optim/DistriOptimizer.scala:669``; topology parse
 ``utils/Engine.scala:346-416``).
 
-Two REAL processes x 2 virtual CPU devices each join a gloo coordinator via
+REAL processes (2 hosts x 2 virtual CPU devices, and the v5e-16-shaped
+4 hosts x 1 device) join a gloo coordinator via
 ``Engine.init`` env vars; per-process record slices (``DistributedDataSet``)
 feed ``jax.make_array_from_process_local_data``; the final weights must match
 a single-process 4-device run on the same global batches (the reference's
@@ -52,14 +53,20 @@ def _single_process_reference(sync_mode: str):
 
 
 @pytest.mark.slow
-def test_two_process_training_matches_single_process(tmp_path):
-    port = 29000 + (os.getpid() % 1000)
+@pytest.mark.parametrize("n_procs,devs_per_proc", [
+    (2, 2),   # 2 hosts x 2 chips
+    (4, 1),   # the v5e-16 4-host shape (1 chip per host here)
+])
+def test_multi_process_training_matches_single_process(tmp_path, n_procs,
+                                                       devs_per_proc):
+    port = 29000 + (os.getpid() % 250) * 4 + n_procs  # distinct per shape
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     procs = [subprocess.Popen(
-        [sys.executable, WORKER, str(pid), "2", str(port), str(tmp_path)],
+        [sys.executable, WORKER, str(pid), str(n_procs), str(port),
+         str(tmp_path), str(devs_per_proc)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        for pid in range(2)]
+        for pid in range(n_procs)]
     outs = []
     for p in procs:
         try:

@@ -215,3 +215,11 @@ class TestDeprecatedValidator:
         assert calc_top5_accuracy(np.asarray([[9, 8, 7, 6, 5, 0.1, 0.2, 0.3]],
                                              np.float32),
                                   np.asarray([8.0])) == (0, 1)
+
+    def test_tie_break_lowest_index(self):
+        # argmax convention: ties resolve to the lowest class index
+        from bigdl_tpu.optim import calc_accuracy
+        assert calc_accuracy(np.asarray([[0.5, 0.5]], np.float32),
+                             np.asarray([1.0])) == (1, 1)
+        assert calc_accuracy(np.asarray([[0.5, 0.5]], np.float32),
+                             np.asarray([2.0])) == (0, 1)
