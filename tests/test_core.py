@@ -148,3 +148,44 @@ class TestFileIO:
         p.write_bytes(b"not a checkpoint")
         with pytest.raises(ValueError):
             bt.utils.load(str(p))
+
+
+class TestDirectedGraph:
+    """reference ``$T/utils/DirectedGraphSpec``: traversal orders, topo sort,
+    cycle detection, edge builder."""
+
+    def _diamond(self):
+        from bigdl_tpu.utils.digraph import DirectedGraph, Node
+        a, b, c, d = Node("a"), Node("b"), Node("c"), Node("d")
+        a >> b >> d
+        a >> c >> d
+        return DirectedGraph(a), (a, b, c, d)
+
+    def test_bfs_dfs_size(self):
+        g, (a, b, c, d) = self._diamond()
+        assert g.size() == 4 and g.edges() == 4
+        bfs = [n.element for n in g.bfs()]
+        assert bfs[0] == "a" and set(bfs) == {"a", "b", "c", "d"}
+        dfs = [n.element for n in g.dfs()]
+        assert dfs[0] == "a" and len(dfs) == 4
+
+    def test_topology_sort_respects_edges(self):
+        g, (a, b, c, d) = self._diamond()
+        order = [n.element for n in g.topology_sort()]
+        assert order.index("a") < order.index("b") < order.index("d")
+        assert order.index("a") < order.index("c") < order.index("d")
+
+    def test_cycle_detection(self):
+        from bigdl_tpu.utils.digraph import DirectedGraph, Node
+        a, b = Node(1), Node(2)
+        a >> b
+        b >> a
+        with pytest.raises(ValueError, match="cycle"):
+            DirectedGraph(a).topology_sort()
+
+    def test_reverse_graph(self):
+        from bigdl_tpu.utils.digraph import DirectedGraph, Node
+        a, b = Node(1), Node(2)
+        a >> b
+        rev = DirectedGraph(b, reverse=True)
+        assert [n.element for n in rev.bfs()] == [2, 1]
