@@ -2,7 +2,8 @@
 
 from bigdl_tpu.dataset.base import (
     Sample, MiniBatch, ByteRecord, Transformer, ChainedTransformer,
-    Identity as IdentityTransformer, SampleToBatch, Prefetch, MTTransformer,
+    Identity as IdentityTransformer, SampleToBatch, BucketBatch, Prefetch,
+    MTTransformer,
     AbstractDataSet, LocalDataSet, DistributedDataSet, DataSet,
 )
 from bigdl_tpu.dataset import image

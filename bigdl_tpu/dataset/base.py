@@ -147,9 +147,12 @@ class SampleToBatch(Transformer[Sample, MiniBatch]):
         if buf and not self.drop_remainder:
             yield self._collate(buf)
 
-    def _collate(self, samples: List[Sample]) -> MiniBatch:
-        if self.feature_padding is not None or self.fixed_length is not None:
-            length = self.fixed_length or max(s.feature.shape[0] for s in samples)
+    def _collate(self, samples: List[Sample],
+                 fixed_length: Optional[int] = None) -> MiniBatch:
+        fixed_length = fixed_length if fixed_length is not None \
+            else self.fixed_length
+        if self.feature_padding is not None or fixed_length is not None:
+            length = fixed_length or max(s.feature.shape[0] for s in samples)
             feats = np.stack([self._pad_to(s.feature, length,
                                            self.feature_padding or 0.0)
                               for s in samples])
@@ -164,6 +167,50 @@ class SampleToBatch(Transformer[Sample, MiniBatch]):
         if labs.ndim == 2 and labs.shape[1] == 1:
             labs = labs[:, 0]
         return MiniBatch(feats, labs)
+
+
+class BucketBatch(SampleToBatch):
+    """Length-bucketed collation for variable-length samples.
+
+    The reference sorts samples by length so batches group similar lengths
+    (``DataSet.sortRDD``, ``DataSet.scala:373-401``) and pads per batch; jit
+    needs STATIC shapes, so here each sample routes to the smallest bucket
+    boundary >= its length and every emitted batch is padded exactly to its
+    bucket — the compiled-program count is bounded by ``len(boundaries)``
+    instead of one program per observed batch-max length.
+    """
+
+    def __init__(self, batch_size: int, boundaries: Sequence[int],
+                 feature_padding: float = 0.0,
+                 label_padding: Optional[float] = None,
+                 drop_remainder: bool = True):
+        super().__init__(batch_size, feature_padding=feature_padding,
+                         label_padding=label_padding,
+                         drop_remainder=drop_remainder)
+        self.boundaries = sorted(int(b) for b in boundaries)
+        if not self.boundaries:
+            raise ValueError("BucketBatch needs at least one boundary")
+
+    def _bucket_of(self, length: int) -> int:
+        for b in self.boundaries:
+            if length <= b:
+                return b
+        raise ValueError(
+            f"sample length {length} exceeds the largest bucket boundary "
+            f"{self.boundaries[-1]}")
+
+    def __call__(self, prev: Iterator[Sample]) -> Iterator[MiniBatch]:
+        buffers: dict = {b: [] for b in self.boundaries}
+        for s in prev:
+            b = self._bucket_of(int(np.atleast_1d(s.feature).shape[0]))
+            buffers[b].append(s)
+            if len(buffers[b]) == self.batch_size:
+                yield self._collate(buffers[b], fixed_length=b)
+                buffers[b] = []
+        if not self.drop_remainder:
+            for b, buf in buffers.items():
+                if buf:
+                    yield self._collate(buf, fixed_length=b)
 
 
 class Prefetch(Transformer[A, A]):

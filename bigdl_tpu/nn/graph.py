@@ -81,34 +81,37 @@ class Graph(Container):
             self.add_module(f"n{i}_{node.module.name}", node.module)
 
     def _topo_sort(self) -> List[Node]:
-        # Delegates to the general DirectedGraph (reference Graph.scala
-        # builds on utils/DirectedGraph the same way): walk the reverse
-        # graph from the outputs, topo-sort forward.
-        from bigdl_tpu.utils.digraph import DirectedGraph
-        from bigdl_tpu.utils.digraph import Node as GNode
-        gnodes: Dict[int, GNode] = {}
-        stack = list(self.output_nodes)
+        # Kahn's algorithm from the output side (reference builds the reverse
+        # graph from a dummy output, ``Graph.scala:183-210``). Deliberately
+        # NOT delegated to utils.digraph: module names derive from this
+        # order (n{i}_ prefixes), so its exact tie-breaking is part of the
+        # checkpoint format and must stay byte-stable.
+        nodes: List[Node] = []
         seen: Dict[int, Node] = {}
+        stack = list(self.output_nodes)
         while stack:
             n = stack.pop()
             if n.id in seen:
                 continue
             seen[n.id] = n
-            gnodes[n.id] = GNode(n)
+            nodes.append(n)
             stack.extend(n.prev)
-        sink = GNode(None)  # virtual sink below the output nodes: the
-        for n in seen.values():  # single source of the reverse walk
+        indegree = {n.id: len(n.prev) for n in nodes}
+        succ: Dict[int, List[Node]] = {n.id: [] for n in nodes}
+        for n in nodes:
             for p in n.prev:
-                gnodes[p.id] >> gnodes[n.id]
-        for o in self.output_nodes:
-            gnodes[o.id] >> sink
-        try:
-            order = [g.element for g in
-                     DirectedGraph(sink, reverse=True).topology_sort()
-                     if g.element is not None]
-        except ValueError:
+                succ[p.id].append(n)
+        ready = [n for n in nodes if indegree[n.id] == 0]
+        order: List[Node] = []
+        while ready:
+            n = ready.pop()
+            order.append(n)
+            for s in succ[n.id]:
+                indegree[s.id] -= 1
+                if indegree[s.id] == 0:
+                    ready.append(s)
+        if len(order) != len(nodes):
             raise ValueError("Graph contains a cycle")
-        order.reverse()
         for n in self.input_nodes:
             if n.id not in seen:
                 raise ValueError("An input node is not connected to any output")

@@ -6,5 +6,5 @@ from bigdl_tpu.utils.rng import RandomGenerator, manual_seed
 from bigdl_tpu.utils import file_io
 from bigdl_tpu.utils.file_io import save, load
 from bigdl_tpu.utils.util import kth_largest
-from bigdl_tpu.utils.digraph import DirectedGraph
+from bigdl_tpu.utils.digraph import DirectedGraph, Node as DiGraphNode
 from bigdl_tpu.utils.logger_filter import redirect_logs

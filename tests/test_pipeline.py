@@ -152,3 +152,37 @@ class TestMTTransformer:
         list(MTTransformer(_Slow(delay), workers=4)(iter(range(n))))
         wall = time.time() - t0
         assert wall < n * delay * 0.75, wall
+
+
+class TestBucketBatch:
+    def _samples(self, lengths):
+        return [Sample(np.full((l, 3), float(l), np.float32),
+                       float(l % 5 + 1)) for l in lengths]
+
+    def test_static_shapes_bounded_by_boundaries(self):
+        from bigdl_tpu.dataset.base import BucketBatch
+        lengths = [3, 7, 12, 5, 9, 2, 15, 8, 4, 11, 6, 16]
+        batches = list(BucketBatch(2, [8, 16], drop_remainder=False)(
+            iter(self._samples(lengths))))
+        shapes = {b.data.shape[1:] for b in batches}
+        assert shapes <= {(8, 3), (16, 3)}, shapes
+        assert sum(b.size() for b in batches) == 12
+
+    def test_remainder_and_overflow(self):
+        from bigdl_tpu.dataset.base import BucketBatch
+        import pytest as _pytest
+        samples = self._samples([3, 9])
+        # drop_remainder default: neither bucket fills with batch 2 -> nothing
+        assert list(BucketBatch(2, [4, 12])(iter(samples))) == []
+        got = list(BucketBatch(2, [4, 12], drop_remainder=False)(
+            iter(samples)))
+        assert {b.data.shape for b in got} == {(1, 4, 3), (1, 12, 3)}
+        with _pytest.raises(ValueError, match="exceeds"):
+            list(BucketBatch(1, [4])(iter(self._samples([9]))))
+
+    def test_padding_values(self):
+        from bigdl_tpu.dataset.base import BucketBatch
+        (b,) = BucketBatch(1, [6], feature_padding=-1.0,
+                           drop_remainder=False)(iter(self._samples([4])))
+        assert b.data.shape == (1, 6, 3)
+        assert np.all(b.data[0, 4:] == -1.0) and np.all(b.data[0, :4] == 4.0)
