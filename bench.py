@@ -259,17 +259,17 @@ def run_worker(args):
 # --------------------------------------------------------------------------
 
 def _attempt(name, worker, batch, steps, budget_s, platform="",
-             precision="bf16"):
+             precision="bf16", grace=90):
     cmd = [sys.executable, os.path.abspath(__file__),
            "--worker", worker, "--batch", str(batch), "--steps", str(steps),
            "--budget", str(budget_s), "--precision", precision]
     if platform:
         cmd += ["--platform", platform]
-    log(f"attempt {name}: {' '.join(cmd[2:])} (timeout {budget_s + 90}s)")
+    log(f"attempt {name}: {' '.join(cmd[2:])} (timeout {budget_s + grace}s)")
     try:
         proc = subprocess.run(
             cmd, stdout=subprocess.PIPE, stderr=sys.stderr,
-            timeout=budget_s + 90)  # grace for interpreter/backend teardown
+            timeout=budget_s + grace)  # interpreter/backend teardown margin
     except subprocess.TimeoutExpired:
         log(f"attempt {name}: KILLED on timeout")
         return None
@@ -397,16 +397,17 @@ def main():
     for name, worker, batch, steps, budget, platform in attempts:
         rem = remaining() - (0 if platform == "cpu" else cpu_reserve)
         # TPU compile alone takes minutes: an attempt whose post-clamp
-        # budget would fall under ~4 min (TPU) / 2 min (CPU) can only burn
-        # wall-clock, never succeed. rem - 90 is the clamped budget below.
-        min_useful = 240 if platform != "cpu" else 120
-        if rem - 90 < min_useful:
+        # budget would fall under ~4 min can only burn wall-clock, never
+        # succeed. CPU compiles in seconds, so even a thin remaining slice
+        # beats emitting nothing. grace = subprocess kill margin.
+        min_useful, grace = (20, 30) if platform == "cpu" else (240, 90)
+        if rem - grace < min_useful:
             log(f"attempt {name}: SKIPPED ({remaining():.0f}s left in "
                 "global budget)")
             continue
-        budget = min(budget, rem - 90)  # keep the kill-grace inside rem
+        budget = min(budget, rem - grace)
         res = _attempt(name, worker, batch, steps, budget, platform,
-                       args.precision)
+                       args.precision, grace=grace)
         if res is not None:
             print(json.dumps(res), flush=True)
             return

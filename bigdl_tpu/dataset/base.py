@@ -202,7 +202,10 @@ class BucketBatch(SampleToBatch):
     def __call__(self, prev: Iterator[Sample]) -> Iterator[MiniBatch]:
         buffers: dict = {b: [] for b in self.boundaries}
         for s in prev:
-            b = self._bucket_of(int(np.atleast_1d(s.feature).shape[0]))
+            if s.feature.ndim == 0:
+                raise ValueError("BucketBatch needs samples with a leading "
+                                 "(length) dimension; got a scalar feature")
+            b = self._bucket_of(int(s.feature.shape[0]))
             buffers[b].append(s)
             if len(buffers[b]) == self.batch_size:
                 yield self._collate(buffers[b], fixed_length=b)

@@ -1,0 +1,118 @@
+"""Unit tests for bench.py's orchestration logic (the driver-facing
+contract: ALWAYS emit one parseable JSON line, survive wedged backends,
+respect the global wall budget). The worker side runs on real hardware; here
+the attempt/probe layers are stubbed.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench", os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py"))
+bench = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench)
+
+
+def run_main(monkeypatch, capsys, argv, attempts_log, probe=True,
+             results=None, env=None):
+    """Drive bench.main() with _attempt/_probe_backend stubbed; returns the
+    parsed final JSON line."""
+    results = results or {}
+
+    def fake_attempt(name, worker, batch, steps, budget, platform="",
+                     precision="bf16", grace=90):
+        attempts_log.append((name, worker, batch, budget, platform))
+        return results.get(name)
+
+    monkeypatch.setattr(bench, "_attempt", fake_attempt)
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: probe)
+    for k, v in (env or {}).items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setattr(sys, "argv", ["bench.py"] + argv)
+    monkeypatch.setattr(bench, "_T_START", bench.time.monotonic())
+    code = 0
+    try:
+        bench.main()
+    except SystemExit as e:
+        code = e.code or 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out, "bench printed no JSON line"
+    return json.loads(out[-1]), code
+
+
+def test_first_success_wins(monkeypatch, capsys):
+    log = []
+    res = {"resnet50-b256": {"metric": "m", "value": 2526.0,
+                             "unit": "u", "vs_baseline": 0.63}}
+    parsed, code = run_main(monkeypatch, capsys, [], log, results=res)
+    assert code == 0 and parsed["value"] == 2526.0
+    assert [a[0] for a in log] == ["resnet50-b256"]
+
+
+def test_all_fail_emits_diagnostic_json(monkeypatch, capsys):
+    log = []
+    parsed, code = run_main(monkeypatch, capsys, [], log)
+    assert parsed["metric"] == "bench_failed" and code == 1
+    assert "vs_baseline" in parsed
+    # every configured attempt was tried before giving up
+    assert len(log) >= 3
+
+
+def test_dead_probe_skips_tpu_attempts(monkeypatch, capsys):
+    log = []
+    parsed, code = run_main(monkeypatch, capsys, [], log, probe=False)
+    assert all(a[4] == "cpu" for a in log), log
+
+
+def test_model_filter_keeps_cpu_fallback(monkeypatch, capsys):
+    log = []
+    parsed, _ = run_main(monkeypatch, capsys, ["--model", "resnet50"], log)
+    workers = {a[1] for a in log}
+    assert workers == {"resnet50"}
+    assert any(a[4] == "cpu" for a in log), "no CPU fallback attempt"
+
+
+def test_batch_override_dedupes_attempts(monkeypatch, capsys):
+    log = []
+    run_main(monkeypatch, capsys, ["--batch", "64"], log)
+    keys = [(a[1], a[2], a[4]) for a in log]
+    assert len(keys) == len(set(keys)), f"duplicate attempts: {keys}"
+    assert all(a[2] == 64 for a in log)
+
+
+def test_unparseable_total_budget_ignored(monkeypatch, capsys):
+    log = []
+    parsed, code = run_main(monkeypatch, capsys, [], log,
+                            env={"BENCH_TOTAL_BUDGET": "20m"})
+    assert parsed["metric"] == "bench_failed" and len(log) >= 3
+
+
+def test_exhausted_budget_skips_straight_to_cpu(monkeypatch, capsys):
+    log = []
+    # pretend the run started ~18 min ago: no TPU attempt fits, but the CPU
+    # fallback must still be attempted rather than emitting nothing
+    monkeypatch.setattr(bench, "_T_START", bench.time.monotonic() - 1100)
+    res = {"lenet-cpu": {"metric": "m", "value": 1.0, "unit": "u",
+                         "vs_baseline": 0.0}}
+
+    def fake_attempt(name, worker, batch, steps, budget, platform="",
+                     precision="bf16", grace=90):
+        log.append((name, worker, batch, budget, platform))
+        return res.get(name)
+
+    monkeypatch.setattr(bench, "_attempt", fake_attempt)
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: True)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    try:
+        bench.main()
+    except SystemExit:
+        pass
+    out = capsys.readouterr().out.strip().splitlines()
+    parsed = json.loads(out[-1])
+    assert all(a[4] == "cpu" for a in log), log
+    assert parsed["value"] == 1.0
