@@ -19,9 +19,12 @@ from __future__ import annotations
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Optional, Sequence
+import logging
+from typing import List, Optional, Sequence
 
 import numpy as np
+
+logger = logging.getLogger("bigdl_tpu")
 
 
 class _EngineState:
@@ -69,6 +72,42 @@ class Engine:
             native.set_num_threads(_state.core_number)
         except Exception:  # pragma: no cover - native layer is optional
             pass
+        Engine.check_env()
+
+    @staticmethod
+    def check_env(strict: bool = False) -> List[str]:
+        """Verify the launch environment the way the reference verifies its
+        required spark conf (``Engine.checkSparkContext``,
+        ``utils/Engine.scala:269-293`` against ``spark-bigdl.conf:31-43``).
+
+        ``scripts/bigdl-tpu.sh`` sets these; a bare ``python`` invocation
+        gets warnings (or, with ``strict=True`` ≙ the reference's
+        ``forceCheck``, an error) listing what's off. Returns the list of
+        complaint strings. Suppress with ``BIGDL_TPU_DISABLE_ENV_CHECK=1``
+        (reference ``bigdl.disableCheckSysEnv``)."""
+        problems: List[str] = []
+        disable = os.environ.get("BIGDL_TPU_DISABLE_ENV_CHECK", "")
+        if disable.strip().lower() not in ("", "0", "false", "no"):
+            return problems
+        if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+            problems.append(
+                "JAX_COMPILATION_CACHE_DIR is unset: every process pays the "
+                "full XLA compile (20-40s for large models); run under "
+                "scripts/bigdl-tpu.sh or export a cache dir")
+        omp = os.environ.get("OMP_NUM_THREADS")
+        if omp is not None:
+            omp = omp.strip()
+        if omp is None or not omp.isdigit() or int(omp) > 4:
+            problems.append(
+                f"OMP_NUM_THREADS={omp or '<unset>'}: host BLAS/OpenMP "
+                "threads fight the data-pipeline IO pool; the launcher "
+                "pins it to 1 (reference spark-bigdl.conf OMP_NUM_THREADS=1)")
+        for p in problems:
+            logger.warning("[Engine.check_env] %s", p)
+        if strict and problems:
+            raise RuntimeError("launch environment check failed:\n  "
+                               + "\n  ".join(problems))
+        return problems
 
     @staticmethod
     def _maybe_init_distributed() -> None:

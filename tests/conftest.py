@@ -9,6 +9,9 @@ every sharding/collective path compiles and runs without TPU hardware.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# keep Engine.init()'s launch-env advisory quiet in test logs; the check
+# itself is covered explicitly by tests/test_core.py::TestEngineEnvCheck
+os.environ.setdefault("BIGDL_TPU_DISABLE_ENV_CHECK", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -189,3 +189,31 @@ class TestDirectedGraph:
         a >> b
         rev = DirectedGraph(b, reverse=True)
         assert [n.element for n in rev.bfs()] == [2, 1]
+
+
+class TestEngineEnvCheck:
+    """reference ``Engine.checkSparkContext`` / required-conf verification
+    (``utils/Engine.scala:269-293``)."""
+
+    def test_complaints_and_strict(self, monkeypatch):
+        from bigdl_tpu.utils.engine import Engine
+        monkeypatch.delenv("BIGDL_TPU_DISABLE_ENV_CHECK", raising=False)
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+        monkeypatch.setenv("OMP_NUM_THREADS", "16")
+        problems = Engine.check_env()
+        assert len(problems) == 2
+        with pytest.raises(RuntimeError, match="environment check"):
+            Engine.check_env(strict=True)
+
+    def test_clean_env_passes(self, monkeypatch):
+        from bigdl_tpu.utils.engine import Engine
+        monkeypatch.delenv("BIGDL_TPU_DISABLE_ENV_CHECK", raising=False)
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/tmp/c")
+        monkeypatch.setenv("OMP_NUM_THREADS", "1")
+        assert Engine.check_env(strict=True) == []
+
+    def test_disable_switch(self, monkeypatch):
+        from bigdl_tpu.utils.engine import Engine
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+        monkeypatch.setenv("BIGDL_TPU_DISABLE_ENV_CHECK", "1")
+        assert Engine.check_env(strict=True) == []
