@@ -57,6 +57,8 @@ from bigdl_tpu.nn.regularization import (
     Dropout, L1Penalty, Regularizer, L1Regularizer, L2Regularizer,
     L1L2Regularizer,
 )
+from bigdl_tpu.nn.reduce import (Sum, Mean, Max, Min, CosineDistance,
+                                 PairwiseDistance)
 from bigdl_tpu.nn.graph import Graph, Input, Node
 from bigdl_tpu.nn.detection import Nms, nms
 from bigdl_tpu.nn.recurrent import (

@@ -1,0 +1,118 @@
+"""Dimension-reduction and pairwise-distance layers (reference ``nn/Sum.scala``,
+``nn/Mean.scala``, ``nn/Max.scala``, ``nn/Min.scala``,
+``nn/CosineDistance.scala``, ``nn/PairwiseDistance.scala``).
+
+Reference dimension conventions: ``dimension`` is 1-based; negative counts
+from the end; when ``n_input_dims`` is given and the input carries one extra
+leading (batch) dim, the reduction dim shifts by one (``getPositiveDimension``
+in ``Sum.scala:64``). The reduced axis is squeezed from the output as the
+reference does.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module, TensorModule
+
+
+def _positive_axis(input, dimension: int, n_input_dims: int) -> int:
+    d = dimension
+    if d < 0:
+        d = input.ndim + d + 1
+    elif n_input_dims > 0 and input.ndim == n_input_dims + 1:
+        d += 1  # batched input: shift past the batch dim
+    if not 1 <= d <= input.ndim:
+        raise IndexError(f"dimension {dimension} out of range for "
+                         f"{input.ndim}-d input")
+    return d - 1
+
+
+class Sum(TensorModule):
+    """Sum over one dimension (reference ``nn/Sum.scala``)."""
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 size_average: bool = False):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.size_average = size_average
+
+    def update_output(self, input):
+        ax = _positive_axis(input, self.dimension, self.n_input_dims)
+        out = jnp.sum(input, axis=ax)
+        if self.size_average:
+            out = out / input.shape[ax]
+        return out
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.dimension})"
+
+
+class Mean(Sum):
+    """Mean over one dimension (reference ``nn/Mean.scala``)."""
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1):
+        super().__init__(dimension, n_input_dims, size_average=True)
+
+
+class Max(TensorModule):
+    """Max over one dimension (reference ``nn/Max.scala``)."""
+
+    _reduce = staticmethod(jnp.max)
+
+    def __init__(self, dim: int = 1, num_input_dims: int = -1):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def update_output(self, input):
+        ax = _positive_axis(input, self.dim, self.num_input_dims)
+        return self._reduce(input, axis=ax)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.dim})"
+
+
+class Min(Max):
+    """Min over one dimension (reference ``nn/Min.scala``)."""
+
+    _reduce = staticmethod(jnp.min)
+
+
+class CosineDistance(Module):
+    """Cosine similarity of a Table {x1, x2} -> (N, 1)
+    (reference ``nn/CosineDistance.scala``)."""
+
+    def update_output(self, input):
+        x1, x2 = input[1], input[2]
+        squeeze = x1.ndim == 1
+        if squeeze:
+            x1, x2 = x1[None], x2[None]
+        num = jnp.sum(x1 * x2, axis=1, keepdims=True)
+        n1 = jnp.maximum(jnp.sum(x1 * x1, axis=1, keepdims=True), 1e-12)
+        n2 = jnp.maximum(jnp.sum(x2 * x2, axis=1, keepdims=True), 1e-12)
+        out = num / jnp.sqrt(n1 * n2)
+        return out[0] if squeeze else out
+
+
+class PairwiseDistance(Module):
+    """p-norm distance of a Table {x1, x2} -> (N,)
+    (reference ``nn/PairwiseDistance.scala``)."""
+
+    def __init__(self, norm: int = 2, eps: float = 1e-6):
+        super().__init__()
+        self.norm = norm
+        # eps keeps the p-root differentiable at distance 0 (identical
+        # pairs): autodiff of sum(|d|^p)^(1/p) is NaN there otherwise, and
+        # one duplicate pair would poison the whole batch gradient
+        self.eps = eps
+
+    def update_output(self, input):
+        x1, x2 = input[1], input[2]
+        squeeze = x1.ndim == 1
+        if squeeze:
+            x1, x2 = x1[None], x2[None]
+        diff = jnp.abs(x1 - x2) + self.eps
+        out = jnp.sum(diff ** self.norm, axis=1) ** (1.0 / self.norm)
+        return out[0] if squeeze else out

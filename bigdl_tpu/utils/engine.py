@@ -97,7 +97,7 @@ class Engine:
         omp = os.environ.get("OMP_NUM_THREADS")
         if omp is not None:
             omp = omp.strip()
-        if omp is None or not omp.isdigit() or int(omp) > 4:
+        if omp is None or not omp.isdigit() or not 1 <= int(omp) <= 4:
             problems.append(
                 f"OMP_NUM_THREADS={omp or '<unset>'}: host BLAS/OpenMP "
                 "threads fight the data-pipeline IO pool; the launcher "

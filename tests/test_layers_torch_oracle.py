@@ -419,3 +419,64 @@ class TestBackwardOracles:
             w_torch.grad.numpy(), rtol=1e-3, atol=1e-3)
         np.testing.assert_allclose(np.asarray(gp["bias"]),
                                    b_torch.grad.numpy(), rtol=1e-3, atol=1e-3)
+
+
+class TestReduceAndDistanceLayers:
+    def test_sum_mean_max_min(self):
+        x = np.random.randn(4, 5, 6).astype(np.float32)
+        jx = jnp.asarray(x)
+        np.testing.assert_allclose(np.asarray(nn.Sum(2).forward(jx)),
+                                   x.sum(1), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(np.asarray(nn.Mean(3).forward(jx)),
+                                   x.mean(2), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(np.asarray(nn.Max(1).forward(jx)),
+                                   x.max(0), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(np.asarray(nn.Min(-1).forward(jx)),
+                                   x.min(-1), rtol=RTOL, atol=ATOL)
+        # batch-dim shift: n_input_dims=2 on a 3-d input reduces dim+1
+        np.testing.assert_allclose(
+            np.asarray(nn.Sum(1, n_input_dims=2).forward(jx)), x.sum(1),
+            rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(
+            np.asarray(nn.Sum(2, size_average=True).forward(jx)), x.mean(1),
+            rtol=RTOL, atol=ATOL)
+
+    def test_cosine_distance_matches_torch(self):
+        from bigdl_tpu.utils.table import T as Tb
+        x1 = np.random.randn(5, 7).astype(np.float32)
+        x2 = np.random.randn(5, 7).astype(np.float32)
+        ref = F.cosine_similarity(torch.from_numpy(x1),
+                                  torch.from_numpy(x2)).numpy()
+        out = np.asarray(nn.CosineDistance().forward(
+            Tb(jnp.asarray(x1), jnp.asarray(x2))))
+        np.testing.assert_allclose(out[:, 0], ref, rtol=RTOL, atol=ATOL)
+
+    def test_pairwise_distance_matches_torch(self):
+        from bigdl_tpu.utils.table import T as Tb
+        x1 = np.random.randn(5, 7).astype(np.float32)
+        x2 = np.random.randn(5, 7).astype(np.float32)
+        for p in (1, 2):
+            ref = F.pairwise_distance(torch.from_numpy(x1),
+                                      torch.from_numpy(x2), p=p,
+                                      eps=0.0).numpy()
+            out = np.asarray(nn.PairwiseDistance(p).forward(
+                Tb(jnp.asarray(x1), jnp.asarray(x2))))
+            np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_pairwise_distance_grad_finite_at_zero(self):
+        import jax
+        from bigdl_tpu.utils.table import T as Tb
+        x = jnp.ones((4, 3), jnp.float32)
+
+        def loss(a):
+            return jnp.sum(nn.PairwiseDistance(2).forward(Tb(a, x)))
+
+        g = jax.grad(loss)(x)  # identical pair: gradient must stay finite
+        assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_distance_layers_vector_input_shapes(self):
+        from bigdl_tpu.utils.table import T as Tb
+        v = jnp.asarray(np.random.randn(7).astype(np.float32))
+        w = jnp.asarray(np.random.randn(7).astype(np.float32))
+        assert nn.CosineDistance().forward(Tb(v, w)).shape == (1,)
+        assert nn.PairwiseDistance().forward(Tb(v, w)).shape == ()
