@@ -290,6 +290,75 @@ IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp",
                     ".ppm", ".tif", ".tiff")
 
 
+# Channel-agnostic crop: the reference's Grey variant is the same operation
+# on 1-channel data (``dataset/image/GreyImgCropper.scala``).
+GreyImgCropper = BGRImgCropper
+
+
+class BGRImgPixelNormalizer(Transformer[LabeledImage, LabeledImage]):
+    """Subtract a per-pixel mean image (reference
+    ``BGRImgPixelNormalizer.scala``: ImageNet mean file); the mean must match
+    the image shape."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        for img in prev:
+            if img.data.shape != self.means.shape:
+                raise ValueError(f"mean image shape {self.means.shape} != "
+                                 f"image shape {img.data.shape}")
+            yield LabeledImage(img.data - self.means, img.label)
+
+
+class MTLabeledBGRImgToBatch(Transformer[LabeledImage, "MiniBatch"]):
+    """Multithreaded transform + collate (reference
+    ``MTLabeledBGRImgToBatch.scala``: worker threads each run their own
+    transformer clone, then batches are assembled). Composed from the
+    generic pieces: ``MTTransformer(transformer)`` >> ``BGRImgToBatch``."""
+
+    aggregating = True
+
+    def __init__(self, width: int, height: int, batch_size: int,
+                 transformer: Transformer, workers: int = 4):
+        from bigdl_tpu.dataset.base import MTTransformer
+        self.width, self.height = width, height
+        self._chain = (MTTransformer(transformer, workers=workers)
+                       >> BGRImgToBatch(batch_size))
+
+    def __call__(self, prev: Iterator[LabeledImage]):
+        for batch in self._chain(prev):
+            h, w = batch.data.shape[1:3]
+            if (h, w) != (self.height, self.width):
+                raise ValueError(
+                    f"transformed images are {h}x{w}, expected "
+                    f"{self.height}x{self.width} (the declared batch "
+                    "geometry — add a cropper/resizer to the transformer)")
+            yield batch
+
+
+class BGRImgToImageVector(Transformer[LabeledImage, Sample]):
+    """Flatten images to plain feature vectors for the sklearn-protocol
+    classifier (reference ``BGRImgToImageVector.scala`` feeds Spark-ML
+    DenseVectors to DLClassifier)."""
+
+    def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[Sample]:
+        for img in prev:
+            yield Sample(np.asarray(img.data, np.float32).ravel(), img.label)
+
+
+class LocalImgReaderWithName(LocalImgReader):
+    """Like LocalImgReader but yields (path, LabeledImage) so predictions
+    can be joined back to files (reference
+    ``LocalImgReaderWithName.scala``)."""
+
+    def __call__(self, prev: Iterator[Tuple[str, float]]):
+        for path, label in prev:
+            yield path, LabeledImage(
+                _decode_scaled_bgr(path, self.scale_to, type(self).__name__),
+                label)
+
+
 def image_folder_paths(folder: str, extensions=IMAGE_EXTENSIONS):
     """(path, 1-based label) pairs from a labeled image tree — one
     subdirectory per class, labels assigned by sorted class name (reference

@@ -33,6 +33,7 @@ class ShardWriter:
         self._in_shard = 0
         self._file = None
         self._writer: Optional[RecordWriter] = None
+        self.written_paths: List[str] = []
         os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
 
     def _roll(self) -> None:
@@ -41,6 +42,7 @@ class ShardWriter:
         path = f"{self.path_prefix}-{self._shard_idx:05d}{_SUFFIX}"
         self._file = open(path, "wb")
         self._writer = RecordWriter(self._file)
+        self.written_paths.append(path)
         self._shard_idx += 1
         self._in_shard = 0
 
@@ -222,3 +224,38 @@ class StreamingShardDataSet(AbstractDataSet):
         # paths are already host-sliced (ShardFolder.paths): same contract
         # as files()'s DistributedDataSet(shard_by_process=False)
         return True
+
+
+class BGRImgToLocalSeqFile:
+    """Pack LabeledImages into local shard files, yielding the paths it
+    wrote (reference ``BGRImgToLocalSeqFile.scala`` writes Hadoop
+    SequenceFiles). Wire format: interleaved uint8 pixels (what
+    ``BytesToBGRImg`` decodes) — pack BEFORE normalization; out-of-range
+    pixel values error rather than silently wrapping modulo 256."""
+
+    def __init__(self, path_prefix: str, block_size: int = 1024):
+        self.path_prefix = path_prefix
+        self.block_size = block_size
+
+    def __call__(self, prev):
+        import numpy as np
+        with ShardWriter(self.path_prefix,
+                         records_per_shard=self.block_size) as writer:
+            for img in prev:
+                data = np.asarray(img.data)
+                if data.min() < 0 or data.max() > 255:
+                    raise ValueError(
+                        "image pixels outside [0, 255] cannot be packed as "
+                        "uint8 — write raw images, normalize on the read "
+                        f"side (got range [{data.min()}, {data.max()}])")
+                writer.write(img.label, data.astype(np.uint8).tobytes())
+        yield from writer.written_paths
+
+
+class LocalSeqFileToBytes:
+    """Read shard files back to ByteRecords (reference
+    ``LocalSeqFileToBytes.scala``); input items are shard paths."""
+
+    def __call__(self, prev) -> Iterator[ByteRecord]:
+        for path in prev:
+            yield from read_shard(path)

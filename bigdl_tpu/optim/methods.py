@@ -392,6 +392,48 @@ class RMSprop(OptimMethod):
                             "evalCounter": state["evalCounter"] + 1}
 
 
+def _wolfe_line_search(feval, x, d, f0, g0, t0, c1: float = 1e-4,
+                       c2: float = 0.9, max_iter: int = 25,
+                       t_max: float = 1e8):
+    """Strong-Wolfe line search along ``d`` (reference ``LineSearch.scala``
+    lswolfe): bracket by doubling, then bisect until both the sufficient-
+    decrease (Armijo, c1) and curvature (c2) conditions hold.
+
+    Returns (t, f_t, g_t, n_evals); host-side loop around jitted fevals —
+    the same CPU-control/TPU-compute split as LBFGS itself."""
+    import math
+    gtd0 = float(jnp.dot(g0, d))
+    lo_t, lo_f, lo_g = 0.0, f0, g0
+    hi_t = None
+    t = t0
+    evals = 0
+    for _ in range(max_iter):
+        f_t, g_t = feval(x + t * d)
+        f_t = float(f_t)
+        evals += 1
+        gtd = float(jnp.dot(g_t, d))
+        if not math.isfinite(f_t):
+            hi_t = t  # overflow at this step: shrink, never extend
+        elif f_t > f0 + c1 * t * gtd0 or (evals > 1 and f_t >= lo_f):
+            hi_t = t  # overshot: minimum bracketed in (lo_t, t)
+        elif abs(gtd) <= -c2 * gtd0:
+            return t, f_t, g_t, evals  # strong Wolfe satisfied
+        elif gtd >= 0:
+            hi_t = t  # slope turned positive: bracketed
+        else:
+            lo_t, lo_f, lo_g = t, f_t, g_t
+            if hi_t is None:
+                t = min(2.0 * t, t_max)  # still descending: extend
+                continue
+        t = 0.5 * (lo_t + hi_t)  # bisect the bracket
+        if hi_t - lo_t < 1e-12:
+            break
+    # Wolfe not met within budget: fall back to the best EVALUATED point
+    # (t=0 = no step if nothing improved) — returning a re-bisected t whose
+    # f/g were never evaluated would corrupt the L-BFGS curvature pairs.
+    return lo_t, lo_f, lo_g, evals
+
+
 class LBFGS(OptimMethod):
     """Limited-memory BFGS with optional line search
     (reference ``optim/LBFGS.scala:38`` + ``LineSearch.scala``).
@@ -459,10 +501,20 @@ class LBFGS(OptimMethod):
                 break
             t = min(1.0, 1.0 / float(jnp.sum(jnp.abs(g_flat)))) \
                 if it == 0 else self.learningrate
-            x_flat = x_flat + t * d
-            loss, g = feval(unravel(x_flat))
-            g_flat, _ = ravel_pytree(g)
-            n_eval += 1
+            if self.linesearch:
+                def feval_flat(xf):
+                    l, gr = feval(unravel(xf))
+                    return l, ravel_pytree(gr)[0]
+
+                t, loss, g_flat, evals = _wolfe_line_search(
+                    feval_flat, x_flat, d, float(loss), g_flat, t)
+                x_flat = x_flat + t * d
+                n_eval += evals
+            else:
+                x_flat = x_flat + t * d
+                loss, g = feval(unravel(x_flat))
+                g_flat, _ = ravel_pytree(g)
+                n_eval += 1
             losses.append(float(loss))
             if n_eval >= self.max_eval:
                 break

@@ -176,3 +176,61 @@ class TestKthLargest:
     def test_bounds(self):
         with pytest.raises(ValueError):
             kth_largest([1.0], 2)
+
+
+class TestImageParityNames:
+    """The remaining reference image-pipeline components
+    (``dataset/image/*.scala`` file-for-file)."""
+
+    def _imgs(self, n=6, h=4, w=4):
+        from bigdl_tpu.dataset.image import LabeledImage
+        rng = np.random.RandomState(0)
+        return [LabeledImage(rng.randint(0, 255, (h, w, 3)).astype(np.float32),
+                             float(i % 2 + 1)) for i, _ in enumerate(range(n))]
+
+    def test_pixel_normalizer(self):
+        from bigdl_tpu.dataset.image import BGRImgPixelNormalizer
+        imgs = self._imgs(2)
+        mean = np.full((4, 4, 3), 10.0, np.float32)
+        out = list(BGRImgPixelNormalizer(mean)(iter(imgs)))
+        np.testing.assert_allclose(out[0].data, imgs[0].data - 10.0)
+        with pytest.raises(ValueError, match="shape"):
+            list(BGRImgPixelNormalizer(np.zeros((2, 2, 3)))(iter(imgs)))
+
+    def test_mt_labeled_to_batch(self):
+        from bigdl_tpu.dataset.image import (HFlip, MTLabeledBGRImgToBatch)
+        batches = list(MTLabeledBGRImgToBatch(
+            4, 4, batch_size=3, transformer=HFlip(0.0), workers=2)(
+            iter(self._imgs(6))))
+        assert len(batches) == 2 and batches[0].data.shape == (3, 4, 4, 3)
+
+    def test_img_to_image_vector(self):
+        from bigdl_tpu.dataset.image import BGRImgToImageVector
+        (s, *_) = BGRImgToImageVector()(iter(self._imgs(1)))
+        assert s.feature.shape == (48,) and s.label == 1.0
+
+    def test_seqfile_bridge_roundtrip(self, tmp_path):
+        from bigdl_tpu.dataset.image import BytesToBGRImg
+        from bigdl_tpu.dataset.shards import (BGRImgToLocalSeqFile,
+                                              LocalSeqFileToBytes)
+        imgs = self._imgs(5)
+        paths = list(BGRImgToLocalSeqFile(str(tmp_path / "s" / "part"),
+                                          block_size=2)(iter(imgs)))
+        assert len(paths) == 3  # 2+2+1
+        records = list(LocalSeqFileToBytes()(iter(paths)))
+        decoded = list(BytesToBGRImg(4, 4)(iter(records)))
+        assert len(decoded) == 5
+        np.testing.assert_allclose(decoded[0].data, imgs[0].data)
+
+    def test_reader_with_name(self, tmp_path):
+        from PIL import Image
+        from bigdl_tpu.dataset.image import LocalImgReaderWithName
+        p = tmp_path / "x.png"
+        Image.new("RGB", (8, 8), (1, 2, 3)).save(p)
+        ((path, img),) = LocalImgReaderWithName(8)(iter([(str(p), 2.0)]))
+        assert path == str(p) and img.data.shape == (8, 8, 3)
+        assert img.label == 2.0
+
+    def test_grey_cropper_alias(self):
+        from bigdl_tpu.dataset.image import BGRImgCropper, GreyImgCropper
+        assert GreyImgCropper is BGRImgCropper

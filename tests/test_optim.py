@@ -223,3 +223,29 @@ class TestDeprecatedValidator:
                              np.asarray([1.0])) == (1, 1)
         assert calc_accuracy(np.asarray([[0.5, 0.5]], np.float32),
                              np.asarray([2.0])) == (0, 1)
+
+
+class TestWolfeLineSearch:
+    def test_satisfies_strong_wolfe_on_quadratic(self):
+        from bigdl_tpu.optim.methods import _wolfe_line_search
+        # f(x) = 0.5 * ||x - 1||^2 along d = -grad from x=0
+        def feval(x):
+            return 0.5 * jnp.sum((x - 1.0) ** 2), x - 1.0
+
+        x = jnp.zeros(3)
+        f0, g0 = feval(x)
+        d = -g0
+        t, f_t, g_t, evals = _wolfe_line_search(feval, x, d, float(f0), g0,
+                                                t0=0.1)
+        gtd0 = float(jnp.dot(g0, d))
+        assert f_t <= float(f0) + 1e-4 * t * gtd0      # Armijo
+        assert abs(float(jnp.dot(g_t, d))) <= 0.9 * abs(gtd0)  # curvature
+        assert evals <= 25
+
+    def test_lbfgs_with_linesearch_converges(self):
+        def feval(x):
+            return rosenbrock_ish(x), jax.grad(rosenbrock_ish)(x)
+
+        x, losses = LBFGS(max_iter=30, linesearch=True).optimize(
+            feval, jnp.asarray([0.0, 0.0]))
+        assert losses[-1] < 1e-5, losses[-1]
