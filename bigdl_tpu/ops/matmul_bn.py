@@ -1,0 +1,111 @@
+"""Fused matmul + batch-norm statistics: the conv(1x1)+BN epilogue fusion.
+
+PERF.md's single-chip analysis: the train-mode ResNet step is
+bandwidth-bound, and after the fused-BN rewrite the biggest remaining
+avoidable traffic is re-READING each conv output to compute BN statistics
+(~5.6 GB of bf16 activations per forward at b=256). A 1x1 convolution is
+exactly a matmul over (N*H*W, Cin) x (Cin, Cout) — and ~half of ResNet-50's
+convs are 1x1 — so this kernel computes
+
+    y = x @ w,   col_sum[j] = sum_m y[m, j],   col_sumsq[j] = sum_m y[m, j]^2
+
+in ONE pass: per-column partial sums accumulate in VMEM scratch while each
+output tile is still register/VMEM-resident, eliminating the separate
+stats-reduction read of y. XLA cannot express this fusion (reductions don't
+fuse into conv epilogues on this toolchain); Pallas can.
+
+Grid layout: (n_blocks, m_blocks) — the LAST grid dimension iterates
+fastest on TPU, so for a fixed column block j the kernel sweeps all row
+blocks i, accumulating into a persistent (1, block_n) scratch that is
+zeroed at i == 0 and flushed to the sums outputs at the final i.
+
+Correctness is interpret-mode tested on CPU (tests/test_matmul_bn.py);
+wiring it into the ResNet bottleneck path is gated on an on-chip A/B
+(see PERF.md) — the kernel must beat XLA's native matmul by more than the
+stats read it saves.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, w_ref, y_ref, sum_ref, sq_ref, acc_sum, acc_sq):
+    i = pl.program_id(1)  # row block — innermost
+
+    y = jnp.dot(x_ref[...], w_ref[...], preferred_element_type=jnp.float32)
+
+    @pl.when(i == 0)
+    def _zero():
+        acc_sum[...] = jnp.zeros_like(acc_sum)
+        acc_sq[...] = jnp.zeros_like(acc_sq)
+
+    acc_sum[...] += jnp.sum(y, axis=0, keepdims=True)
+    acc_sq[...] += jnp.sum(y * y, axis=0, keepdims=True)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _flush():
+        sum_ref[...] = acc_sum[...]
+        sq_ref[...] = acc_sq[...]
+
+
+def _pad_to(x, m: int, axis: int):
+    short = m - x.shape[axis] % m
+    if short == m:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, short)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_m", "block_n", "interpret"))
+def matmul_with_stats(x, w, block_m: int = 256, block_n: int = 256,
+                      interpret: Optional[bool] = None
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """``(y, col_sum, col_sumsq)`` for ``y = x @ w`` in one pass.
+
+    x: (M, K); w: (K, N). Sums accumulate in fp32 regardless of input dtype
+    (same policy as ``ops.batch_norm``). Zero-padded rows contribute zeros
+    to both sums, so no masking is needed for ragged M.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2, (x.shape, w.shape)
+    xp = _pad_to(x, block_m, 0)
+    wp = _pad_to(w, block_n, 1)
+    mp, np_ = xp.shape[0], wp.shape[1]
+
+    y, s, sq = pl.pallas_call(
+        _kernel,
+        grid=(np_ // block_n, mp // block_m),
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda j, i: (i, 0)),
+            pl.BlockSpec((k, block_n), lambda j, i: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_m, block_n), lambda j, i: (i, j)),
+            pl.BlockSpec((1, block_n), lambda j, i: (0, j)),
+            pl.BlockSpec((1, block_n), lambda j, i: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, np_), x.dtype),
+            jax.ShapeDtypeStruct((1, np_), jnp.float32),
+            jax.ShapeDtypeStruct((1, np_), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, block_n), jnp.float32),
+            pltpu.VMEM((1, block_n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp, wp)
+    return y[:m, :n], s[0, :n], sq[0, :n]

@@ -1,0 +1,56 @@
+"""Fused matmul + BN-stats Pallas kernel (interpret mode on CPU;
+``ops/matmul_bn.py``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.ops.matmul_bn import matmul_with_stats
+
+
+@pytest.mark.parametrize("m,k,n,bm,bn", [
+    (512, 64, 256, 256, 256),   # aligned
+    (300, 48, 100, 128, 128),   # ragged m and n
+    (64, 16, 128, 256, 256),    # single (padded) block
+])
+def test_matches_unfused(m, k, n, bm, bn):
+    rng = np.random.RandomState(0)
+    x = rng.randn(m, k).astype(np.float32)
+    w = rng.randn(k, n).astype(np.float32)
+    y, s, sq = matmul_with_stats(jnp.asarray(x), jnp.asarray(w),
+                                 block_m=bm, block_n=bn, interpret=True)
+    ref = x @ w
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s), ref.sum(0), rtol=1e-4,
+                               atol=1e-3)
+    np.testing.assert_allclose(np.asarray(sq), (ref * ref).sum(0),
+                               rtol=1e-4, atol=1e-2)
+
+
+def test_bf16_inputs_fp32_stats():
+    rng = np.random.RandomState(1)
+    x = rng.randn(256, 32).astype(np.float32)
+    w = rng.randn(32, 128).astype(np.float32)
+    y, s, sq = matmul_with_stats(jnp.asarray(x, jnp.bfloat16),
+                                 jnp.asarray(w, jnp.bfloat16),
+                                 block_m=128, block_n=128, interpret=True)
+    assert y.dtype == jnp.bfloat16
+    assert s.dtype == jnp.float32 and sq.dtype == jnp.float32
+    ref = x @ w
+    np.testing.assert_allclose(np.asarray(s), ref.sum(0), rtol=5e-2,
+                               atol=1.0)
+
+
+def test_stats_feed_batch_norm_exactly():
+    # mean/var derived from the fused sums must match ops.batch_norm's own
+    rng = np.random.RandomState(2)
+    x = rng.randn(384, 24).astype(np.float32)
+    w = rng.randn(24, 64).astype(np.float32)
+    y, s, sq = matmul_with_stats(jnp.asarray(x), jnp.asarray(w),
+                                 block_m=128, block_n=64, interpret=True)
+    m = x.shape[0]
+    mean = np.asarray(s) / m
+    var = np.asarray(sq) / m - mean ** 2
+    ref = x @ w
+    np.testing.assert_allclose(mean, ref.mean(0), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(var, ref.var(0), rtol=1e-3, atol=1e-3)
