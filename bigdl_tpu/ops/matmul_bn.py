@@ -14,10 +14,13 @@ output tile is still register/VMEM-resident, eliminating the separate
 stats-reduction read of y. XLA cannot express this fusion (reductions don't
 fuse into conv epilogues on this toolchain); Pallas can.
 
-Grid layout: (n_blocks, m_blocks) — the LAST grid dimension iterates
-fastest on TPU, so for a fixed column block j the kernel sweeps all row
-blocks i, accumulating into a persistent (1, block_n) scratch that is
-zeroed at i == 0 and flushed to the sums outputs at the final i.
+Grid layout: one axis over row blocks. The whole weight matrix stays
+VMEM-resident (every ResNet 1x1 weight is <= 2 MB bf16, far under VMEM),
+so x streams through exactly once, y is written exactly once, and the sums
+accumulate directly into their (1, N) output blocks — which Pallas keeps
+resident across the sweep because their index map is constant. Any other
+grid order re-streams x or w per block and the re-read can exceed the
+stats read this kernel exists to save.
 
 Correctness is interpret-mode tested on CPU (tests/test_matmul_bn.py);
 wiring it into the ResNet bottleneck path is gated on an on-chip A/B
@@ -33,27 +36,21 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 
-def _kernel(x_ref, w_ref, y_ref, sum_ref, sq_ref, acc_sum, acc_sq):
-    i = pl.program_id(1)  # row block — innermost
+def _kernel(x_ref, w_ref, y_ref, sum_ref, sq_ref):
+    i = pl.program_id(0)  # row block
 
     y = jnp.dot(x_ref[...], w_ref[...], preferred_element_type=jnp.float32)
 
     @pl.when(i == 0)
     def _zero():
-        acc_sum[...] = jnp.zeros_like(acc_sum)
-        acc_sq[...] = jnp.zeros_like(acc_sq)
+        sum_ref[...] = jnp.zeros_like(sum_ref)
+        sq_ref[...] = jnp.zeros_like(sq_ref)
 
-    acc_sum[...] += jnp.sum(y, axis=0, keepdims=True)
-    acc_sq[...] += jnp.sum(y * y, axis=0, keepdims=True)
+    sum_ref[...] += jnp.sum(y, axis=0, keepdims=True)
+    sq_ref[...] += jnp.sum(y * y, axis=0, keepdims=True)
     y_ref[...] = y.astype(y_ref.dtype)
-
-    @pl.when(i == pl.num_programs(1) - 1)
-    def _flush():
-        sum_ref[...] = acc_sum[...]
-        sq_ref[...] = acc_sq[...]
 
 
 def _pad_to(x, m: int, axis: int):
@@ -67,14 +64,16 @@ def _pad_to(x, m: int, axis: int):
 
 @functools.partial(jax.jit,
                    static_argnames=("block_m", "block_n", "interpret"))
-def matmul_with_stats(x, w, block_m: int = 256, block_n: int = 256,
+def matmul_with_stats(x, w, block_m: int = 256, block_n: int = 128,
                       interpret: Optional[bool] = None
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """``(y, col_sum, col_sumsq)`` for ``y = x @ w`` in one pass.
 
     x: (M, K); w: (K, N). Sums accumulate in fp32 regardless of input dtype
-    (same policy as ``ops.batch_norm``). Zero-padded rows contribute zeros
-    to both sums, so no masking is needed for ragged M.
+    (same policy as ``ops.batch_norm``). Zero-padded rows/cols contribute
+    zeros to both sums, so no masking is needed for ragged shapes.
+    ``block_n`` only pads N up to lane alignment — the full width stays
+    resident per row block.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -84,27 +83,28 @@ def matmul_with_stats(x, w, block_m: int = 256, block_n: int = 256,
     xp = _pad_to(x, block_m, 0)
     wp = _pad_to(w, block_n, 1)
     mp, np_ = xp.shape[0], wp.shape[1]
+    if k * np_ * wp.dtype.itemsize > 8 * 2 ** 20:
+        raise ValueError(
+            f"w ({k}x{np_}) exceeds the VMEM-resident budget this kernel "
+            "assumes (8 MB); every ResNet 1x1 fits — tile N upstream for "
+            "wider layers")
 
     y, s, sq = pl.pallas_call(
         _kernel,
-        grid=(np_ // block_n, mp // block_m),
+        grid=(mp // block_m,),
         in_specs=[
-            pl.BlockSpec((block_m, k), lambda j, i: (i, 0)),
-            pl.BlockSpec((k, block_n), lambda j, i: (0, j)),
+            pl.BlockSpec((block_m, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, np_), lambda i: (0, 0)),  # w fully resident
         ],
         out_specs=[
-            pl.BlockSpec((block_m, block_n), lambda j, i: (i, j)),
-            pl.BlockSpec((1, block_n), lambda j, i: (0, j)),
-            pl.BlockSpec((1, block_n), lambda j, i: (0, j)),
+            pl.BlockSpec((block_m, np_), lambda i: (i, 0)),
+            pl.BlockSpec((1, np_), lambda i: (0, 0)),  # resident accumulator
+            pl.BlockSpec((1, np_), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((mp, np_), x.dtype),
             jax.ShapeDtypeStruct((1, np_), jnp.float32),
             jax.ShapeDtypeStruct((1, np_), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((1, block_n), jnp.float32),
-            pltpu.VMEM((1, block_n), jnp.float32),
         ],
         interpret=interpret,
     )(xp, wp)

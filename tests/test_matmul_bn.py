@@ -42,7 +42,10 @@ def test_bf16_inputs_fp32_stats():
 
 
 def test_stats_feed_batch_norm_exactly():
-    # mean/var derived from the fused sums must match ops.batch_norm's own
+    # mean/var derived from the fused sums must match what
+    # ops.batch_norm.batch_norm_train itself computes on y (same
+    # clamped-variance recipe: var = max(E[y^2] - E[y]^2, 0))
+    from bigdl_tpu.ops.batch_norm import batch_norm_train
     rng = np.random.RandomState(2)
     x = rng.randn(384, 24).astype(np.float32)
     w = rng.randn(24, 64).astype(np.float32)
@@ -50,7 +53,9 @@ def test_stats_feed_batch_norm_exactly():
                                  block_m=128, block_n=64, interpret=True)
     m = x.shape[0]
     mean = np.asarray(s) / m
-    var = np.asarray(sq) / m - mean ** 2
-    ref = x @ w
-    np.testing.assert_allclose(mean, ref.mean(0), rtol=1e-4, atol=1e-4)
-    np.testing.assert_allclose(var, ref.var(0), rtol=1e-3, atol=1e-3)
+    var = np.maximum(np.asarray(sq) / m - mean ** 2, 0.0)
+    _, bn_mean, bn_var = batch_norm_train(
+        jnp.asarray(y), jnp.ones(64), jnp.zeros(64), 1e-5)
+    np.testing.assert_allclose(mean, np.asarray(bn_mean), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(var, np.asarray(bn_var), rtol=1e-3, atol=1e-3)
