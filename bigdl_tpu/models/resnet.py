@@ -26,6 +26,24 @@ def _conv(n_in, n_out, k, stride=1, pad=0):
                                  with_bias=False, init_method="kaiming")
 
 
+def _use_fused_1x1() -> bool:
+    import os
+    return os.environ.get("BIGDL_TPU_FUSED_1X1", "").strip().lower() \
+        in ("1", "true", "yes")
+
+
+def _add_conv_bn(seq, n_in, n_out, k, stride=1, pad=0):
+    """conv(+BN) pair; 1x1 pairs collapse into the Pallas-fused module when
+    ``BIGDL_TPU_FUSED_1X1=1`` (opt-in pending the on-chip A/B — see PERF.md;
+    note the fused module changes parameter-tree naming, so checkpoints are
+    not interchangeable across the flag)."""
+    if k == 1 and pad == 0 and _use_fused_1x1():
+        from bigdl_tpu.nn.fused import FusedConv1x1BN
+        return seq.add(FusedConv1x1BN(n_in, n_out, stride))
+    return (seq.add(_conv(n_in, n_out, k, stride, pad))
+            .add(nn.SpatialBatchNormalization(n_out)))
+
+
 def _shortcut(n_in, n_out, stride, shortcut_type="B"):
     if n_in != n_out or stride != 1:
         if shortcut_type == "A":
@@ -33,9 +51,7 @@ def _shortcut(n_in, n_out, stride, shortcut_type="B"):
             return (nn.Sequential()
                     .add(nn.SpatialAveragePooling(1, 1, stride, stride))
                     .add(nn.Padding(3, n_out - n_in, 3)))
-        return (nn.Sequential()
-                .add(_conv(n_in, n_out, 1, stride))
-                .add(nn.SpatialBatchNormalization(n_out)))
+        return _add_conv_bn(nn.Sequential(), n_in, n_out, 1, stride)
     return nn.Identity()
 
 
@@ -55,15 +71,11 @@ def _basic_block(n_in, n_out, stride, shortcut_type="B"):
 
 def _bottleneck(n_in, n_mid, stride, shortcut_type="B"):
     n_out = n_mid * 4
-    main = (nn.Sequential()
-            .add(_conv(n_in, n_mid, 1))
-            .add(nn.SpatialBatchNormalization(n_mid))
-            .add(nn.ReLU())
-            .add(_conv(n_mid, n_mid, 3, stride, 1))
-            .add(nn.SpatialBatchNormalization(n_mid))
-            .add(nn.ReLU())
-            .add(_conv(n_mid, n_out, 1))
-            .add(nn.SpatialBatchNormalization(n_out)))
+    main = _add_conv_bn(nn.Sequential(), n_in, n_mid, 1)
+    main.add(nn.ReLU())
+    _add_conv_bn(main, n_mid, n_mid, 3, stride, 1)
+    main.add(nn.ReLU())
+    _add_conv_bn(main, n_mid, n_out, 1)
     return (nn.Sequential()
             .add(nn.ConcatTable().add(main).add(_shortcut(n_in, n_out, stride,
                                                           shortcut_type)))

@@ -59,13 +59,8 @@ class SpatialConvolution(TensorModule):
     def _init_params(self, w_reg=None, b_reg=None):
         fan_in = self.kernel_h * self.kernel_w * self.n_input_plane // self.n_group
         fan_out = self.kernel_h * self.kernel_w * self.n_output_plane // self.n_group
-        shape = self._weight_shape()
-        if self.init_method == "xavier":
-            w = init.xavier(shape, fan_in, fan_out)
-        elif self.init_method == "kaiming":
-            w = init.kaiming(shape, fan_in)
-        else:
-            w = init.default_init(shape, fan_in)
+        w = init.conv_weight(self.init_method, self._weight_shape(),
+                             fan_in, fan_out)
         self.register_parameter("weight", w, regularizer=w_reg)
         if self.with_bias:
             self.register_parameter("bias", init.default_init((self.n_output_plane,), fan_in),

@@ -1,0 +1,63 @@
+"""Fused layers — TPU-specific compositions that replace adjacent reference
+layers with one kernel-backed module.
+
+``FusedConv1x1BN`` == ``SpatialConvolution(k=1, bias=False)`` +
+``SpatialBatchNormalization``, with the train-mode forward running the
+Pallas fused matmul+stats kernel (``ops/conv_bn.py``). Drop-in for the
+conv/BN pairs a model builder would otherwise chain (the ResNet bottleneck
+path adopts it behind ``BIGDL_TPU_FUSED_1X1=1``). Weight layout stays conv
+HWIO ``(1, 1, n_in, n_out)`` for importer parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import initialization as init
+from bigdl_tpu.nn.module import TensorModule
+
+
+class FusedConv1x1BN(TensorModule):
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 stride: int = 1, eps: float = 1e-5,
+                 momentum: float = 0.1, init_method: str = "kaiming"):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.stride = stride
+        self.eps, self.momentum = eps, momentum
+        fan_in = n_input_plane
+        self.register_parameter(
+            "weight", init.conv_weight(init_method,
+                                       (1, 1, n_input_plane, n_output_plane),
+                                       fan_in, n_output_plane))
+        self.register_parameter("gamma", init.ones((n_output_plane,)))
+        self.register_parameter("beta", init.zeros((n_output_plane,)))
+        self.register_buffer("running_mean", init.zeros((n_output_plane,)))
+        self.register_buffer("running_var", init.ones((n_output_plane,)))
+
+    def update_output(self, input):
+        x = input
+        if self.stride > 1:  # 1x1 conv with stride == subsample then matmul
+            x = x[:, ::self.stride, ::self.stride, :]
+        n, h, w_, c = x.shape
+        x2d = x.reshape(n * h * w_, c)
+        wmat = self.weight[0, 0]
+        if self.training:
+            from bigdl_tpu.nn.normalization import blend_running_stats
+            from bigdl_tpu.ops.conv_bn import conv1x1_bn_train
+            out2d, mean, var = conv1x1_bn_train(x2d, wmat, self.gamma,
+                                                self.beta, self.eps)
+            blend_running_stats(self, mean, var, x2d.shape[0], self.momentum)
+        else:
+            y = x2d @ wmat
+            inv = jax.lax.rsqrt(self.running_var + self.eps)
+            out2d = ((y.astype(jnp.float32) - self.running_mean) * inv
+                     * self.gamma + self.beta).astype(x.dtype)
+        return out2d.reshape(n, h, w_, self.n_output_plane)
+
+    def __repr__(self):
+        return (f"FusedConv1x1BN({self.n_input_plane} -> "
+                f"{self.n_output_plane}, stride={self.stride})")

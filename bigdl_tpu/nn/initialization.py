@@ -50,6 +50,17 @@ def bilinear_filler(shape: Sequence[int]) -> np.ndarray:
     return out
 
 
+def conv_weight(method: str, shape: Sequence[int], fan_in: int,
+                fan_out: int) -> np.ndarray:
+    """Conv-weight init dispatch shared by SpatialConvolution and the fused
+    conv modules ("xavier" | "kaiming" | "default")."""
+    if method == "xavier":
+        return xavier(shape, fan_in, fan_out)
+    if method == "kaiming":
+        return kaiming(shape, fan_in)
+    return default_init(shape, fan_in)
+
+
 def zeros(shape: Sequence[int]) -> np.ndarray:
     return np.zeros(tuple(shape), dtype=np.float32)
 

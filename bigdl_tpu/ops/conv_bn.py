@@ -1,0 +1,68 @@
+"""Fused 1x1-conv + train-mode batch-norm: forward runs the Pallas
+``matmul_with_stats`` kernel (one pass produces the conv output AND its BN
+statistics — no separate stats read of the activation), normalize is one
+elementwise pass, and the backward reuses the closed-form BN gradient
+(``ops/batch_norm.py``) followed by plain matmul grads.
+
+This is the composition PERF.md identifies as the next single-chip lever;
+the ResNet builder adopts it behind ``BIGDL_TPU_FUSED_1X1=1``
+(``models/resnet.py``) pending an on-chip A/B.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops.matmul_bn import matmul_with_stats
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def conv1x1_bn_train(x2d, w, gamma, beta, eps, interpret=None):
+    """``x2d`` (M, K) @ ``w`` (K, N), batch-normalized over M with batch
+    statistics; returns ``(out, mean, var)`` (stats fp32, biased var —
+    the same contract as ``ops.batch_norm.batch_norm_train``)."""
+    out, mean, var, *_ = _forward(x2d, w, gamma, beta, eps, interpret)
+    return out, mean, var
+
+
+def _forward(x2d, w, gamma, beta, eps, interpret):
+    m = x2d.shape[0]
+    y, s, sq = matmul_with_stats(x2d, w, interpret=interpret)
+    mean = s / m
+    var = jnp.maximum(sq / m - mean * mean, 0.0)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = (y.astype(jnp.float32) - mean) * inv
+    out = (xhat * gamma.astype(jnp.float32)
+           + beta.astype(jnp.float32)).astype(x2d.dtype)
+    return out, mean, var, y, inv
+
+
+def _fwd(x2d, w, gamma, beta, eps, interpret):
+    out, mean, var, y, inv = _forward(x2d, w, gamma, beta, eps, interpret)
+    return (out, mean, var), (x2d, w, gamma, y, mean, inv)
+
+
+def _bwd(eps, interpret, res, cts):
+    dout, _dmean, _dvar = cts  # stats feed running buffers: non-diff
+    x2d, w, gamma, y, mean, inv = res
+    m = x2d.shape[0]
+    dy = dout.astype(jnp.float32)
+    xhat = (y.astype(jnp.float32) - mean) * inv
+    dbeta = jnp.sum(dy, axis=0)
+    dgamma = jnp.sum(dy * xhat, axis=0)
+    g32 = gamma.astype(jnp.float32)
+    # closed-form BN input gradient (see ops/batch_norm.py), then the
+    # matmul transposes
+    dyconv = (g32 * inv / m) * (m * dy - dbeta - xhat * dgamma)
+    dyconv = dyconv.astype(x2d.dtype)
+    dx = dyconv @ w.T
+    dw = x2d.T @ dyconv
+    return (dx, dw.astype(w.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(gamma.dtype))
+
+
+conv1x1_bn_train.defvjp(_fwd, _bwd)

@@ -83,11 +83,17 @@ def matmul_with_stats(x, w, block_m: int = 256, block_n: int = 128,
     xp = _pad_to(x, block_m, 0)
     wp = _pad_to(w, block_n, 1)
     mp, np_ = xp.shape[0], wp.shape[1]
-    if k * np_ * wp.dtype.itemsize > 8 * 2 ** 20:
+    # Per-step VMEM: resident w + one x tile + one (block_m, N) y tile
+    # (fp32 in-kernel) + fp32 accumulators. Every ResNet 1x1 fits easily.
+    vmem = (k * np_ * wp.dtype.itemsize          # w, resident
+            + block_m * k * xp.dtype.itemsize    # x tile
+            + block_m * np_ * 4                  # y tile (fp32 compute)
+            + 2 * np_ * 4)                       # sum/sumsq accumulators
+    if vmem > 12 * 2 ** 20:
         raise ValueError(
-            f"w ({k}x{np_}) exceeds the VMEM-resident budget this kernel "
-            "assumes (8 MB); every ResNet 1x1 fits — tile N upstream for "
-            "wider layers")
+            f"per-step VMEM footprint ~{vmem >> 20} MB for ({m}x{k})@"
+            f"({k}x{n}) with block_m={block_m} exceeds the 12 MB budget "
+            "this kernel assumes; shrink block_m or tile N upstream")
 
     y, s, sq = pl.pallas_call(
         _kernel,

@@ -1,0 +1,107 @@
+"""FusedConv1x1BN must be numerically interchangeable with the
+SpatialConvolution(1x1) + SpatialBatchNormalization pair it replaces
+(interpret-mode Pallas on CPU; ``nn/fused.py``, ``ops/conv_bn.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.fused import FusedConv1x1BN
+from bigdl_tpu.nn.module import functional_apply
+
+
+def _pair(cin, cout, stride):
+    pair = (nn.Sequential()
+            .add(nn.SpatialConvolution(cin, cout, 1, 1, stride, stride,
+                                       with_bias=False))
+            .add(nn.SpatialBatchNormalization(cout)))
+    return pair
+
+
+def _sync(fused, pair):
+    conv, bn = pair[0], pair[1]
+    fused.weight = jnp.asarray(conv.weight)
+    fused.gamma = jnp.asarray(bn.weight)
+    fused.beta = jnp.asarray(bn.bias)
+
+
+@pytest.mark.parametrize("stride", [1, 2])
+def test_training_forward_and_grads_match_pair(stride):
+    cin, cout = 8, 16
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 8, 8, cin).astype(np.float32))
+    pair = _pair(cin, cout, stride)
+    fused = FusedConv1x1BN(cin, cout, stride)
+    _sync(fused, pair)
+
+    def loss(module, p):
+        out, buf = functional_apply(module, p, module.buffer_tree(), x,
+                                    training=True)
+        return jnp.sum(out ** 2), (out, buf)
+
+    p_pair = pair.parameter_tree()
+    p_fused = fused.parameter_tree()
+    (l1, (o1, b1)), g1 = jax.value_and_grad(
+        lambda p: loss(pair, p), has_aux=True)(p_pair)
+    (l2, (o2, b2)), g2 = jax.value_and_grad(
+        lambda p: loss(fused, p), has_aux=True)(p_fused)
+
+    np.testing.assert_allclose(np.asarray(o2), np.asarray(o1), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(float(l2), float(l1), rtol=1e-5)
+    # gradient parity, matched across the two naming schemes
+    conv_key, bn_key = sorted(g1.keys())
+    np.testing.assert_allclose(np.asarray(g2["weight"]),
+                               np.asarray(g1[conv_key]["weight"]),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(g2["gamma"]),
+                               np.asarray(g1[bn_key]["weight"]),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(g2["beta"]),
+                               np.asarray(g1[bn_key]["bias"]),
+                               rtol=1e-3, atol=1e-3)
+    # running-stat buffers update identically, matched BY NAME
+    def by_name(tree):
+        out = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            key = str(path[-1].key if hasattr(path[-1], "key") else path[-1])
+            out[key] = np.asarray(leaf)
+        return out
+
+    n1, n2 = by_name(b1), by_name(b2)
+    for name in ("running_mean", "running_var"):
+        np.testing.assert_allclose(n2[name], n1[name], rtol=1e-3, atol=1e-3,
+                                   err_msg=name)
+
+
+def test_eval_uses_running_stats():
+    cin, cout = 4, 8
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 4, 4, cin).astype(np.float32))
+    pair = _pair(cin, cout, 1)
+    fused = FusedConv1x1BN(cin, cout, 1)
+    _sync(fused, pair)
+    # one training pass to move the running stats, applied to both
+    pair.training_mode()
+    fused.training_mode()
+    pair.forward(x)
+    fused.forward(x)
+    pair.evaluate_mode()
+    fused.evaluate_mode()
+    np.testing.assert_allclose(np.asarray(fused.forward(x)),
+                               np.asarray(pair.forward(x)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_builder_flag(monkeypatch):
+    from bigdl_tpu.models import resnet
+    monkeypatch.setenv("BIGDL_TPU_FUSED_1X1", "1")
+    model = resnet.build(10, depth=50)
+    reprs = repr(model)
+    assert "FusedConv1x1BN" in reprs
+    out = model.forward(jnp.zeros((1, 224, 224, 3)))
+    assert out.shape == (1, 10)
+    monkeypatch.delenv("BIGDL_TPU_FUSED_1X1")
+    assert "FusedConv1x1BN" not in repr(resnet.build(10, depth=50))
