@@ -21,6 +21,11 @@ from bigdl_tpu.nn.module import TensorModule
 
 
 class FusedConv1x1BN(TensorModule):
+    """1x1 conv + batch norm as ONE module (reference pair:
+    ``SpatialConvolution(k=1)`` + ``SpatialBatchNormalization``): training
+    forward runs the Pallas fused matmul+stats kernel, eval folds BN into
+    the weights."""
+
     def __init__(self, n_input_plane: int, n_output_plane: int,
                  stride: int = 1, eps: float = 1e-5,
                  momentum: float = 0.1, init_method: str = "kaiming"):
@@ -52,10 +57,15 @@ class FusedConv1x1BN(TensorModule):
                                                 self.beta, self.eps)
             blend_running_stats(self, mean, var, x2d.shape[0], self.momentum)
         else:
-            y = x2d @ wmat
+            # classic inference BN folding: normalize moves INTO the weights
+            # (one matmul, no elementwise pass over the activation). Fold in
+            # f32, then matmul in the activation dtype — a bf16 inference
+            # path must keep its bf16 MXU throughput.
             inv = jax.lax.rsqrt(self.running_var + self.eps)
-            out2d = ((y.astype(jnp.float32) - self.running_mean) * inv
-                     * self.gamma + self.beta).astype(x.dtype)
+            scale = (self.gamma * inv).astype(jnp.float32)
+            w_folded = (wmat.astype(jnp.float32) * scale).astype(x2d.dtype)
+            bias = (self.beta - self.running_mean * scale).astype(x2d.dtype)
+            out2d = x2d @ w_folded + bias
         return out2d.reshape(n, h, w_, self.n_output_plane)
 
     def __repr__(self):

@@ -105,3 +105,20 @@ def test_resnet_builder_flag(monkeypatch):
     assert out.shape == (1, 10)
     monkeypatch.delenv("BIGDL_TPU_FUSED_1X1")
     assert "FusedConv1x1BN" not in repr(resnet.build(10, depth=50))
+
+
+def test_eval_folding_preserves_bf16():
+    cin, cout = 4, 8
+    fused = FusedConv1x1BN(cin, cout, 1)
+    fused.evaluate_mode()
+    x = jnp.ones((1, 2, 2, cin), jnp.bfloat16)
+    out = fused.forward(x)
+    assert out.dtype == jnp.bfloat16
+    # numerics match the unfolded formula at fp32 tolerance-for-bf16
+    y = np.asarray(x.reshape(-1, cin), np.float32) @ \
+        np.asarray(fused.weight[0, 0], np.float32)
+    inv = 1.0 / np.sqrt(np.asarray(fused.running_var) + fused.eps)
+    want = (y - np.asarray(fused.running_mean)) * inv \
+        * np.asarray(fused.gamma) + np.asarray(fused.beta)
+    np.testing.assert_allclose(np.asarray(out, np.float32).reshape(-1, cout),
+                               want, rtol=5e-2, atol=5e-2)
