@@ -109,7 +109,16 @@ def test_resnet_builder_flag(monkeypatch):
 
 def test_eval_folding_preserves_bf16():
     cin, cout = 4, 8
+    rng = np.random.RandomState(7)
     fused = FusedConv1x1BN(cin, cout, 1)
+    # non-default BN state: the folding must be validated OFF the identity
+    fused.gamma = jnp.asarray(rng.uniform(0.5, 2.0, cout).astype(np.float32))
+    fused.beta = jnp.asarray(rng.randn(cout).astype(np.float32))
+    fused.load_buffer_tree({
+        "running_mean": jnp.asarray(rng.randn(cout).astype(np.float32)),
+        "running_var": jnp.asarray(
+            rng.uniform(0.2, 3.0, cout).astype(np.float32)),
+    })
     fused.evaluate_mode()
     x = jnp.ones((1, 2, 2, cin), jnp.bfloat16)
     out = fused.forward(x)

@@ -480,3 +480,71 @@ class TestReduceAndDistanceLayers:
         w = jnp.asarray(np.random.randn(7).astype(np.float32))
         assert nn.CosineDistance().forward(Tb(v, w)).shape == (1,)
         assert nn.PairwiseDistance().forward(Tb(v, w)).shape == ()
+
+
+class TestCriterionGradOracles:
+    """gradInput parity — every reference criterion spec checks the
+    backward, not just the loss (``$T/torch/*CriterionSpec``); here
+    jax.grad of our criterion vs torch autograd."""
+
+    def _grad_ours(self, crit, x, target):
+        import jax
+        return np.asarray(jax.grad(
+            lambda a: crit.apply(a, jnp.asarray(target)))(jnp.asarray(x)))
+
+    def _grad_torch(self, fn, x):
+        xt = torch.from_numpy(x).requires_grad_(True)
+        fn(xt).backward()
+        return xt.grad.numpy()
+
+    def test_class_nll_grad(self):
+        x = np.log(np.random.RandomState(0).dirichlet(
+            np.ones(4), 5)).astype(np.float32)
+        t = np.array([1, 2, 3, 4, 1], np.float32)
+        got = self._grad_ours(nn.ClassNLLCriterion(), x, t)
+        want = self._grad_torch(
+            lambda a: F.nll_loss(a, torch.from_numpy(t).long() - 1), x)
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+    def test_cross_entropy_grad(self):
+        x = np.random.RandomState(1).randn(5, 4).astype(np.float32)
+        t = np.array([1, 2, 3, 4, 1], np.float32)
+        got = self._grad_ours(nn.CrossEntropyCriterion(), x, t)
+        want = self._grad_torch(
+            lambda a: F.cross_entropy(a, torch.from_numpy(t).long() - 1), x)
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+    def test_mse_abs_smoothl1_grads(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 6).astype(np.float32)
+        y = rng.randn(4, 6).astype(np.float32)
+        for crit, fn in [
+            (nn.MSECriterion(),
+             lambda a: F.mse_loss(a, torch.from_numpy(y))),
+            (nn.AbsCriterion(),
+             lambda a: F.l1_loss(a, torch.from_numpy(y))),
+            (nn.SmoothL1Criterion(),
+             lambda a: F.smooth_l1_loss(a, torch.from_numpy(y))),
+        ]:
+            got = self._grad_ours(crit, x, y)
+            want = self._grad_torch(fn, x)
+            np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL,
+                                       err_msg=type(crit).__name__)
+
+    def test_bce_grad(self):
+        rng = np.random.RandomState(3)
+        p = rng.uniform(0.1, 0.9, (6,)).astype(np.float32)
+        y = rng.randint(0, 2, (6,)).astype(np.float32)
+        got = self._grad_ours(nn.BCECriterion(), p, y)
+        want = self._grad_torch(
+            lambda a: F.binary_cross_entropy(a, torch.from_numpy(y)), p)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_kldiv_grad(self):
+        rng = np.random.RandomState(4)
+        logp = np.log(rng.dirichlet(np.ones(5), 3)).astype(np.float32)
+        t = rng.dirichlet(np.ones(5), 3).astype(np.float32)
+        got = self._grad_ours(nn.DistKLDivCriterion(), logp, t)
+        want = self._grad_torch(
+            lambda a: F.kl_div(a, torch.from_numpy(t), reduction="mean"), logp)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
