@@ -65,6 +65,29 @@ def _reg_loss(params, reg_pairs):
     return total
 
 
+def make_training_loss_fn(model, criterion, policy, reg_pairs, remat,
+                          buffers, rng, data, labels):
+    """The ONE training loss closure shared by every step builder (local,
+    distributed allreduce, ZeRO-1 sharded): precision cast -> functional
+    forward (optionally rematerialized via ``jax.checkpoint``) -> criterion
+    + regularizer, returning ``(loss, (new_buffers, raw_loss))``."""
+    def forward(p, data):
+        from bigdl_tpu.ops.precision import cast_tree
+        p_c = policy.cast_params_for_compute(p)
+        out, new_buf = functional_apply(model, p_c, buffers, data,
+                                        training=True, rng=rng)
+        return out, cast_tree(new_buf, jnp.float32)
+
+    fwd = jax.checkpoint(forward) if remat else forward
+
+    def loss_fn(p):
+        out, new_buf = fwd(p, data)
+        loss = criterion.apply(out, labels).astype(jnp.float32)
+        return loss + _reg_loss(p, reg_pairs), (new_buf, loss)
+
+    return loss_fn
+
+
 class Optimizer:
     """Facade/factory (reference ``Optimizer.scala:278-333``): constructing
     ``Optimizer(model, dataset, criterion)`` yields a LocalOptimizer or — for
@@ -99,6 +122,7 @@ class Optimizer:
         self.metrics = Metrics()
         self._resume_from: Optional[Tuple[str, str]] = None
         self._profile: Optional[Tuple[str, int, int]] = None
+        self._remat = False
         from bigdl_tpu.ops.precision import DtypePolicy
         self.precision = DtypePolicy.fp32()
 
@@ -145,6 +169,14 @@ class Optimizer:
 
     def set_end_when(self, end_when: Trigger) -> "Optimizer":
         self.end_when = end_when
+        return self
+
+    def set_remat(self, enabled: bool = True) -> "Optimizer":
+        """Rematerialize the forward in the backward pass (``jax.checkpoint``):
+        activation memory drops to O(1) forwards at ~1.3x FLOPs — the
+        standard TPU recipe when a model does not fit HBM. Off by default
+        (compute-bound models should keep their activations)."""
+        self._remat = bool(enabled)
         return self
 
     def set_precision(self, policy) -> "Optimizer":
@@ -216,18 +248,12 @@ class LocalOptimizer(Optimizer):
         model, criterion, optim = self.model, self.criterion, self.optim_method
         reg_pairs = _regularizer_pairs(model)
         policy = self.precision
+        remat = self._remat
 
         def step(params, buffers, opt_state, rng, data, labels):
-            def loss_fn(p):
-                p_c = policy.cast_params_for_compute(p)
-                out, new_buf = functional_apply(model, p_c, buffers,
-                                                data,
-                                                training=True, rng=rng)
-                loss = criterion.apply(out, labels).astype(jnp.float32)
-                from bigdl_tpu.ops.precision import cast_tree
-                new_buf = cast_tree(new_buf, jnp.float32)
-                return loss + _reg_loss(p, reg_pairs), (new_buf, loss)
-
+            loss_fn = make_training_loss_fn(
+                model, criterion, policy, reg_pairs, remat,
+                buffers, rng, data, labels)
             grads, (new_buf, loss) = jax.grad(loss_fn, has_aux=True)(params)
             new_params, new_opt_state = optim.update(grads, opt_state, params)
             return new_params, new_buf, new_opt_state, loss

@@ -51,7 +51,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.nn.module import functional_apply
-from bigdl_tpu.optim.optimizer import LocalOptimizer, Optimizer, _regularizer_pairs, _reg_loss
+from bigdl_tpu.optim.optimizer import (LocalOptimizer, Optimizer,
+                                       _regularizer_pairs, _reg_loss,
+                                       make_training_loss_fn)
 from bigdl_tpu.parallel.mesh import DATA_AXIS, TENSOR_AXIS, MeshTopology
 
 logger = logging.getLogger("bigdl_tpu.optim")
@@ -207,17 +209,12 @@ class DistriOptimizer(LocalOptimizer):
         reg_pairs = _regularizer_pairs(model)
         compress = self.compress_gradients
         policy = self.precision
+        remat = self._remat
 
         def step(params, buffers, opt_state, rng, data, labels):
-            def loss_fn(p):
-                from bigdl_tpu.ops.precision import cast_tree
-                p_c = policy.cast_params_for_compute(p)
-                out, new_buf = functional_apply(model, p_c, buffers,
-                                                data,
-                                                training=True, rng=rng)
-                loss = criterion.apply(out, labels).astype(jnp.float32)
-                new_buf = cast_tree(new_buf, jnp.float32)
-                return loss + _reg_loss(p, reg_pairs), (new_buf, loss)
+            loss_fn = make_training_loss_fn(
+                model, criterion, policy, reg_pairs, remat,
+                buffers, rng, data, labels)
 
             grads, (new_buf, loss) = jax.grad(loss_fn, has_aux=True)(params)
             if compress:
@@ -283,19 +280,14 @@ class DistriOptimizer(LocalOptimizer):
 
         policy = self.precision
 
+        remat = self._remat
+
         def spmd_step(flat_params, buffers, opt_state, rng, data, labels):
             # flat_params: full replicated flat vector (post all-gather state).
             params = unravel(flat_params[:n])
-
-            def loss_fn(p):
-                from bigdl_tpu.ops.precision import cast_tree
-                p_c = policy.cast_params_for_compute(p)
-                out, new_buf = functional_apply(model, p_c, buffers,
-                                                data,
-                                                training=True, rng=rng)
-                loss = criterion.apply(out, labels).astype(jnp.float32)
-                new_buf = cast_tree(new_buf, jnp.float32)
-                return loss + _reg_loss(p, reg_pairs), (new_buf, loss)
+            loss_fn = make_training_loss_fn(
+                model, criterion, policy, reg_pairs, remat,
+                buffers, rng, data, labels)
 
             grads, (new_buf, loss) = jax.grad(loss_fn, has_aux=True)(params)
             flat_grads, _ = ravel_pytree(grads)

@@ -165,3 +165,40 @@ class TestMeshTopology:
     def test_too_many_devices(self):
         with pytest.raises(AssertionError):
             MeshTopology(data=16).build()
+
+
+class TestRemat:
+    def test_remat_training_matches_plain(self):
+        # jax.checkpoint changes memory/FLOPs, never numerics
+        def run(remat):
+            bt.utils.manual_seed(21)
+            model = lenet.build(10)
+            opt = Optimizer(model, make_dataset(128, 64),
+                            nn.ClassNLLCriterion())
+            opt.set_optim_method(SGD(learningrate=0.05, momentum=0.9)) \
+               .set_end_when(Trigger.max_iteration(3)).set_remat(remat)
+            trained = opt.optimize()
+            import jax
+            return [np.asarray(x) for x in
+                    jax.tree_util.tree_leaves(trained.parameter_tree())]
+
+        for a, b in zip(run(False), run(True)):
+            np.testing.assert_allclose(b, a, rtol=1e-6, atol=1e-7)
+
+    @pytest.mark.parametrize("sync_mode", ["allreduce", "sharded"])
+    def test_remat_distributed_matches_plain(self, sync_mode):
+        def run(remat):
+            bt.utils.manual_seed(22)
+            model = lenet.build(10)
+            opt = Optimizer(model, make_dataset(128, 64, distributed=True),
+                            nn.ClassNLLCriterion())
+            opt.sync_mode = sync_mode
+            opt.set_optim_method(SGD(learningrate=0.05)) \
+               .set_end_when(Trigger.max_iteration(2)).set_remat(remat)
+            trained = opt.optimize()
+            import jax
+            return [np.asarray(x) for x in
+                    jax.tree_util.tree_leaves(trained.parameter_tree())]
+
+        for a, b in zip(run(False), run(True)):
+            np.testing.assert_allclose(b, a, rtol=1e-6, atol=1e-7)
