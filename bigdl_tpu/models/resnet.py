@@ -27,9 +27,8 @@ def _conv(n_in, n_out, k, stride=1, pad=0):
 
 
 def _use_fused_1x1() -> bool:
-    import os
-    return os.environ.get("BIGDL_TPU_FUSED_1X1", "").strip().lower() \
-        in ("1", "true", "yes")
+    from bigdl_tpu.nn.fused import use_fused_1x1
+    return use_fused_1x1()
 
 
 def _add_conv_bn(seq, n_in, n_out, k, stride=1, pad=0):

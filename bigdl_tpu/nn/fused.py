@@ -20,6 +20,13 @@ from bigdl_tpu.nn import initialization as init
 from bigdl_tpu.nn.module import TensorModule
 
 
+def use_fused_1x1() -> bool:
+    """The builders' shared opt-in gate (``BIGDL_TPU_FUSED_1X1=1``)."""
+    import os
+    return os.environ.get("BIGDL_TPU_FUSED_1X1", "").strip().lower() \
+        in ("1", "true", "yes")
+
+
 class FusedConv1x1BN(TensorModule):
     """1x1 conv + batch norm as ONE module (reference pair:
     ``SpatialConvolution(k=1)`` + ``SpatialBatchNormalization``): training
@@ -28,16 +35,26 @@ class FusedConv1x1BN(TensorModule):
 
     def __init__(self, n_input_plane: int, n_output_plane: int,
                  stride: int = 1, eps: float = 1e-5,
-                 momentum: float = 0.1, init_method: str = "kaiming"):
+                 momentum: float = 0.1, init_method: str = "kaiming",
+                 with_bias: bool = False):
         super().__init__()
         self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
         self.stride = stride
         self.eps, self.momentum = eps, momentum
+        self.with_bias = with_bias
         fan_in = n_input_plane
         self.register_parameter(
             "weight", init.conv_weight(init_method,
                                        (1, 1, n_input_plane, n_output_plane),
                                        fan_in, n_output_plane))
+        if with_bias:
+            # kept for schema parity with conv+BN pairs whose conv carries a
+            # bias: a pre-BN bias only SHIFTS the batch mean (xhat, and so
+            # the train output, is bias-invariant), so it folds into the
+            # running-mean/eval paths at vector cost
+            self.register_parameter("bias",
+                                    init.default_init((n_output_plane,),
+                                                      fan_in))
         self.register_parameter("gamma", init.ones((n_output_plane,)))
         self.register_parameter("beta", init.zeros((n_output_plane,)))
         self.register_buffer("running_mean", init.zeros((n_output_plane,)))
@@ -55,6 +72,12 @@ class FusedConv1x1BN(TensorModule):
             from bigdl_tpu.ops.conv_bn import conv1x1_bn_train
             out2d, mean, var = conv1x1_bn_train(x2d, wmat, self.gamma,
                                                 self.beta, self.eps)
+            if self.with_bias:
+                # pre-BN bias shifts the batch mean one-for-one and nothing
+                # else; track it in the running stats so eval matches the
+                # unfused conv(+bias)+BN pair exactly
+                mean = mean + jax.lax.stop_gradient(
+                    self.bias.astype(jnp.float32))
             blend_running_stats(self, mean, var, x2d.shape[0], self.momentum)
         else:
             # classic inference BN folding: normalize moves INTO the weights
@@ -64,8 +87,10 @@ class FusedConv1x1BN(TensorModule):
             inv = jax.lax.rsqrt(self.running_var + self.eps)
             scale = (self.gamma * inv).astype(jnp.float32)
             w_folded = (wmat.astype(jnp.float32) * scale).astype(x2d.dtype)
-            bias = (self.beta - self.running_mean * scale).astype(x2d.dtype)
-            out2d = x2d @ w_folded + bias
+            shift = self.beta - self.running_mean * scale
+            if self.with_bias:
+                shift = shift + self.bias.astype(jnp.float32) * scale
+            out2d = x2d @ w_folded + shift.astype(x2d.dtype)
         return out2d.reshape(n, h, w_, self.n_output_plane)
 
     def __repr__(self):

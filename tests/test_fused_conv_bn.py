@@ -131,3 +131,42 @@ def test_eval_folding_preserves_bf16():
         * np.asarray(fused.gamma) + np.asarray(fused.beta)
     np.testing.assert_allclose(np.asarray(out, np.float32).reshape(-1, cout),
                                want, rtol=5e-2, atol=5e-2)
+
+
+def test_inception_v2_builder_flag(monkeypatch):
+    from bigdl_tpu.models import inception
+    monkeypatch.setenv("BIGDL_TPU_FUSED_1X1", "1")
+    model = inception.build_v2(10)
+    assert "FusedConv1x1BN" in repr(model)
+    out = model.forward(jnp.zeros((1, 224, 224, 3)))
+    assert out.shape == (1, 10)
+    monkeypatch.delenv("BIGDL_TPU_FUSED_1X1")
+    assert "FusedConv1x1BN" not in repr(inception.build_v2(10))
+
+
+def test_with_bias_matches_biased_pair():
+    # inception-style pair: conv WITH bias + BN; the fused module's bias
+    # must reproduce it exactly in train output, running stats, and eval
+    cin, cout = 6, 10
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(2, 4, 4, cin).astype(np.float32))
+    pair = (nn.Sequential()
+            .add(nn.SpatialConvolution(cin, cout, 1, 1))  # with_bias default
+            .add(nn.SpatialBatchNormalization(cout)))
+    fused = FusedConv1x1BN(cin, cout, 1, with_bias=True)
+    _sync(fused, pair)
+    fused.bias = jnp.asarray(rng.randn(cout).astype(np.float32))
+    with_b = pair[0]
+    with_b.bias = jnp.asarray(fused.bias)
+
+    pair.training_mode(), fused.training_mode()
+    o1, o2 = pair.forward(x), fused.forward(x)
+    np.testing.assert_allclose(np.asarray(o2), np.asarray(o1), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(fused.running_mean),
+                               np.asarray(pair[1].running_mean),
+                               rtol=1e-4, atol=1e-4)
+    pair.evaluate_mode(), fused.evaluate_mode()
+    np.testing.assert_allclose(np.asarray(fused.forward(x)),
+                               np.asarray(pair.forward(x)),
+                               rtol=1e-4, atol=1e-4)
