@@ -284,6 +284,10 @@ def _attempt(name, worker, batch, steps, budget_s, platform="",
                 log(f"attempt {name}: OK value={res.get('value')}")
                 if platform:
                     res["backend"] = platform
+                    if platform == "cpu":
+                        res["note"] = ("CPU fallback - TPU backend was "
+                                       "unreachable; value is NOT a TPU "
+                                       "number")
                 return res
             except json.JSONDecodeError:
                 continue
