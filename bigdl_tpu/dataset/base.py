@@ -444,7 +444,21 @@ class DistributedDataSet(LocalDataSet[A]):
 
 
 class DataSet:
-    """Factory namespace (reference ``DataSet`` object, ``DataSet.scala:319``)."""
+    """Factory namespace (reference ``DataSet`` object, ``DataSet.scala:319``).
+
+    Examples::
+
+        >>> import numpy as np
+        >>> samples = [Sample(np.zeros((4,), np.float32), float(i % 2 + 1))
+        ...            for i in range(10)]
+        >>> ds = DataSet.array(samples) >> SampleToBatch(4)
+        >>> [b.size() for b in ds.data(train=False)]
+        [4, 4]
+        >>> ds2 = DataSet.array(samples) >> SampleToBatch(4,
+        ...                                               drop_remainder=False)
+        >>> [b.size() for b in ds2.data(train=False)]
+        [4, 4, 2]
+    """
 
     @staticmethod
     def array(data: Sequence, distributed: bool = False):

@@ -42,7 +42,21 @@ class Container(Module):
 
 
 class Sequential(Container):
-    """Chain container (reference ``nn/Sequential.scala:30``)."""
+    """Chain container (reference ``nn/Sequential.scala:30``).
+
+    Examples::
+
+        >>> from bigdl_tpu import nn
+        >>> import jax.numpy as jnp
+        >>> m = (nn.Sequential().add(nn.Linear(4, 8)).add(nn.ReLU())
+        ...      .add(nn.Linear(8, 2)).add(nn.LogSoftMax()))
+        >>> m.forward(jnp.zeros((3, 4))).shape
+        (3, 2)
+        >>> len(m)
+        4
+        >>> sorted(m.parameter_tree()["0"])  # per-child param subtrees
+        ['bias', 'weight']
+    """
 
     def update_output(self, input):
         out = input

@@ -12,6 +12,20 @@ from bigdl_tpu.utils.table import Table
 
 
 class Trigger:
+    """Composable training-state predicate (reference ``optim/Trigger.scala:26``).
+
+    Examples::
+
+        >>> from bigdl_tpu.utils.table import T
+        >>> Trigger.max_epoch(5)(T(epoch=6, neval=1))
+        True
+        >>> Trigger.several_iteration(10)(T(neval=20))
+        True
+        >>> both = Trigger.and_(Trigger.max_epoch(2), Trigger.max_iteration(9))
+        >>> both(T(epoch=3, neval=5))
+        False
+    """
+
     def __init__(self, fn: Callable[[Table], bool], name: str = "trigger",
                  uses_loss: bool = False):
         self._fn = fn

@@ -62,7 +62,23 @@ def _promote(value) -> jnp.ndarray:
 
 class Tensor:
     """N-d tensor with the reference's Torch-style API
-    (reference ``Tensor.scala:35``; math mix-in ``TensorMath.scala:28``)."""
+    (reference ``Tensor.scala:35``; math mix-in ``TensorMath.scala:28``).
+
+    Examples (1-based Torch semantics; the reference's pyspark docs embed
+    runnable snippets the same way)::
+
+        >>> t = Tensor(2, 3)
+        >>> t.size()
+        (2, 3)
+        >>> t.fill(2.0).sum()
+        12.0
+        >>> t.select(1, 1).size()       # first ROW (1-based)
+        (3,)
+        >>> t.narrow(2, 2, 2).size()    # columns 2..3
+        (2, 2)
+        >>> int(Tensor([[1.0, 5.0]]).max(2)[1][1, 1])  # argmax, 1-based
+        2
+    """
 
     __array_priority__ = 100  # numpy defers to our __r*__ ops
 

@@ -1,0 +1,26 @@
+"""Runnable docstring examples (reference §4.6: the pyspark layer docs embed
+doctests executed by ``run-tests.py``). Examples print shapes/ints/bools —
+never raw floats — so they stay numerically stable across platforms."""
+
+import doctest
+
+import pytest
+
+import bigdl_tpu.dataset.base
+import bigdl_tpu.nn.containers
+import bigdl_tpu.optim.triggers
+import bigdl_tpu.tensor.tensor
+
+MODULES = [
+    bigdl_tpu.tensor.tensor,
+    bigdl_tpu.nn.containers,
+    bigdl_tpu.dataset.base,
+    bigdl_tpu.optim.triggers,
+]
+
+
+@pytest.mark.parametrize("mod", MODULES, ids=[m.__name__ for m in MODULES])
+def test_doctests(mod):
+    results = doctest.testmod(mod, verbose=False)
+    assert results.attempted > 0, f"{mod.__name__}: no doctests collected"
+    assert results.failed == 0, f"{mod.__name__}: {results.failed} failures"
