@@ -327,6 +327,7 @@ class DistriOptimizer(LocalOptimizer):
             return new_flat, new_buf, new_opt, loss
 
         step.finalize = lambda flat: unravel(flat[:n])  # flat -> pytree
+        step.jitted = jitted  # inspectable (HLO contract tests, debugging)
         return step
 
     def _build_forward(self) -> Callable:

@@ -1,0 +1,60 @@
+"""Communication-pattern contract: the ZeRO-1 sharded step must lower to
+reduce-scatter + all-gather (the reference AllReduceParameter's
+slice-ownership exchange, ``parameters/AllReduceParameter.scala:62``), NOT a
+plain all-reduce — the whole point of the sharded plane is that no device
+materializes the full gradient reduction."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+from bigdl_tpu.models import lenet
+from bigdl_tpu.optim import SGD
+from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
+from bigdl_tpu.parallel.mesh import MeshTopology
+
+
+def _opt(sync_mode):
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.normal(0, 1, (28, 28, 1)).astype("float32"),
+                      float(rng.integers(1, 11))) for _ in range(16)]
+    ds = DataSet.array(samples, distributed=True) >> SampleToBatch(16)
+    opt = DistriOptimizer(lenet.build(10), ds, nn.ClassNLLCriterion(),
+                          topology=MeshTopology(data=8))
+    opt.sync_mode = sync_mode
+    opt.set_optim_method(SGD(learningrate=0.1))
+    return opt
+
+
+def test_sharded_step_compiles_to_reduce_scatter_all_gather():
+    opt = _opt("sharded")
+    step = opt._build_step()  # also sets the flat geometry (opt._pad)
+    buffers = opt.model.buffer_tree()
+    opt_state = opt._init_opt_state(opt.model.parameter_tree())
+    _, buffers, opt_state = opt._place_state(opt.model.parameter_tree(),
+                                             buffers, opt_state)
+    from jax.flatten_util import ravel_pytree
+    flat, _ = ravel_pytree(opt.model.parameter_tree())
+    flat = jax.device_put(jnp.pad(flat, (0, opt._pad)), opt._replicated)
+    # collectives are inserted by SPMD partitioning: inspect COMPILED HLO
+    txt = step.jitted.lower(flat, buffers, opt_state, jax.random.key(0),
+                            jnp.zeros((16, 28, 28, 1)),
+                            jnp.ones((16,))).compile().as_text()
+    assert "reduce-scatter" in txt, "ZeRO-1 step lost its reduce-scatter"
+    assert "all-gather" in txt, "ZeRO-1 step lost its weight all-gather"
+
+
+def test_allreduce_step_compiles_to_all_reduce():
+    opt = _opt("allreduce")
+    step = opt._build_step()
+    params = opt.model.parameter_tree()
+    buffers = opt.model.buffer_tree()
+    opt_state = opt._init_opt_state(params)
+    params, buffers, opt_state = opt._place_state(params, buffers, opt_state)
+    txt = step.lower(params, buffers, opt_state, jax.random.key(0),
+                     jnp.zeros((16, 28, 28, 1)),
+                     jnp.ones((16,))).compile().as_text()
+    assert "all-reduce" in txt
+    assert "reduce-scatter" not in txt  # plain DP: no slice ownership
