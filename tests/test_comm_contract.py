@@ -58,3 +58,32 @@ def test_allreduce_step_compiles_to_all_reduce():
                      jnp.ones((16,))).compile().as_text()
     assert "all-reduce" in txt
     assert "reduce-scatter" not in txt  # plain DP: no slice ownership
+
+
+def test_ring_attention_compiles_to_collective_permute():
+    # ring attention's defining trait: K/V blocks ROTATE around the ring
+    # (ppermute -> collective-permute), no all-gather of the full sequence
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from bigdl_tpu.nn.module import functional_apply
+    enc = nn.TransformerEncoder(1, 16, 2, 32, causal=True, seq_axis="seq")
+    mesh = MeshTopology(sequence=8).build()
+    params, buffers = enc.parameter_tree(), enc.buffer_tree()
+    x = jnp.zeros((2, 32, 16))
+
+    def loss(p, b, xx):
+        y, _ = functional_apply(enc, p, b, xx, training=False)
+        return jnp.sum(y ** 2)
+
+    fn = jax.jit(shard_map(loss, mesh=mesh,
+                           in_specs=(P(), P(), P(None, "seq", None)),
+                           out_specs=P(), check_vma=False))
+    txt = fn.lower(params, buffers, x).compile().as_text()
+    assert "collective-permute" in txt, "ring attention lost its ring"
+
+
+# NOTE: no MoE collective assertion on purpose — expert parallelism here is
+# GSPMD-sharded (expert_param_specs + jit), so WHICH collectives implement
+# the token routing is the partitioner's choice (observed: all-gather +
+# dynamic-slice on this toolchain), not a design contract of ours. The
+# numerical contract is pinned by test_expert_parallel instead.
