@@ -91,7 +91,29 @@ def make_training_loss_fn(model, criterion, policy, reg_pairs, remat,
 class Optimizer:
     """Facade/factory (reference ``Optimizer.scala:278-333``): constructing
     ``Optimizer(model, dataset, criterion)`` yields a LocalOptimizer or — for
-    a DistributedDataSet — a DistriOptimizer."""
+    a DistributedDataSet — a DistriOptimizer.
+
+    Examples::
+
+        >>> import numpy as np
+        >>> from bigdl_tpu import nn
+        >>> from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+        >>> from bigdl_tpu.optim import SGD, Trigger
+        >>> rng = np.random.RandomState(0)
+        >>> ds = (DataSet.array([Sample(rng.randn(4).astype(np.float32),
+        ...                             float(i % 2 + 1))
+        ...                      for i in range(32)]) >> SampleToBatch(16))
+        >>> model = (nn.Sequential().add(nn.Linear(4, 2))
+        ...          .add(nn.LogSoftMax()))
+        >>> opt = (Optimizer(model, ds, nn.ClassNLLCriterion())
+        ...        .set_optim_method(SGD(learningrate=0.1))
+        ...        .set_end_when(Trigger.max_iteration(2)))
+        >>> type(opt).__name__
+        'LocalOptimizer'
+        >>> trained = opt.optimize()
+        >>> trained is model
+        True
+    """
 
     def __new__(cls, model: Module = None, dataset: AbstractDataSet = None,
                 criterion: Criterion = None, **kwargs):

@@ -8,6 +8,7 @@ import pytest
 
 import bigdl_tpu.dataset.base
 import bigdl_tpu.nn.containers
+import bigdl_tpu.optim.optimizer
 import bigdl_tpu.optim.triggers
 import bigdl_tpu.tensor.tensor
 
@@ -16,6 +17,7 @@ MODULES = [
     bigdl_tpu.nn.containers,
     bigdl_tpu.dataset.base,
     bigdl_tpu.optim.triggers,
+    bigdl_tpu.optim.optimizer,
 ]
 
 
