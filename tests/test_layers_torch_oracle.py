@@ -548,3 +548,33 @@ class TestCriterionGradOracles:
         want = self._grad_torch(
             lambda a: F.kl_div(a, torch.from_numpy(t), reduction="mean"), logp)
         np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_full_conv_grads(self):
+        # deconv backward: the reference oracles gradInput/gradWeight for
+        # SpatialFullConvolution too ($T/torch/SpatialFullConvolutionSpec)
+        import jax
+        from bigdl_tpu.nn.module import functional_apply
+        cin, cout, k = 3, 4, 3
+        m = nn.SpatialFullConvolution(cin, cout, k, k, 2, 2, 1, 1, 1, 1)
+        x = np.random.randn(2, cin, 5, 5).astype(np.float32)
+        w_torch = torch.from_numpy(np.transpose(
+            np.asarray(m.weight), (3, 2, 0, 1))).requires_grad_(True)
+        b_torch = torch.from_numpy(np.asarray(m.bias)).requires_grad_(True)
+        xt = torch.from_numpy(x).requires_grad_(True)
+        (F.conv_transpose2d(xt, w_torch, b_torch, stride=2, padding=1,
+                            output_padding=1) ** 2).sum().backward()
+
+        params = m.parameter_tree()
+
+        def loss(p, xin):
+            out, _ = functional_apply(m, p, {}, xin, training=True)
+            return (out ** 2).sum()
+
+        gp, gx = jax.grad(loss, argnums=(0, 1))(params, jnp.asarray(nhwc(x)))
+        np.testing.assert_allclose(nchw(np.asarray(gx)), xt.grad.numpy(),
+                                   rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(
+            np.transpose(np.asarray(gp["weight"]), (3, 2, 0, 1)),
+            w_torch.grad.numpy(), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(gp["bias"]),
+                                   b_torch.grad.numpy(), rtol=1e-3, atol=1e-3)
