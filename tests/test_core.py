@@ -217,3 +217,39 @@ class TestEngineEnvCheck:
         monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
         monkeypatch.setenv("BIGDL_TPU_DISABLE_ENV_CHECK", "1")
         assert Engine.check_env(strict=True) == []
+
+
+class TestRandomGeneratorDistributions:
+    """reference ``utils/RandomGenerator.scala``: uniform/normal/exponential/
+    cauchy/logNormal/geometric/bernoulli streams — statistical sanity plus
+    seed determinism."""
+
+    def test_statistics(self):
+        from bigdl_tpu.utils.rng import RandomGenerator
+        rng = RandomGenerator(7)
+        n = 20_000
+        u = rng.uniform(2.0, 5.0, n)
+        assert 2.0 <= u.min() and u.max() < 5.0
+        assert abs(u.mean() - 3.5) < 0.05
+        g = rng.normal(1.0, 2.0, n)
+        assert abs(g.mean() - 1.0) < 0.06 and abs(g.std() - 2.0) < 0.06
+        e = rng.exponential(2.0, n)
+        assert e.min() >= 0 and abs(e.mean() - 0.5) < 0.03
+        c = rng.cauchy(0.0, 1.0, n)
+        assert abs(np.median(c)) < 0.05  # mean undefined; median is the pin
+        ln = rng.log_normal(1.0, 0.5, n)
+        assert ln.min() > 0
+        geo = rng.geometric(0.25, n)
+        assert geo.min() >= 1 and abs(geo.mean() - 4.0) < 0.2
+        b = rng.bernoulli(0.3, n)
+        assert set(np.unique(b)) <= {0.0, 1.0}
+        assert abs(b.mean() - 0.3) < 0.02
+
+    def test_seed_determinism_and_randperm(self):
+        from bigdl_tpu.utils.rng import RandomGenerator
+        a = RandomGenerator(123).normal(0, 1, 16)
+        b = RandomGenerator(123).normal(0, 1, 16)
+        np.testing.assert_array_equal(a, b)
+        p = RandomGenerator(5).randperm(50)
+        assert sorted(p.tolist()) == list(range(1, 51)) or \
+            sorted(p.tolist()) == list(range(50))
