@@ -1,0 +1,35 @@
+"""Causal transformer language model — the flagship of the NEW long-context
+capability (no reference analogue; SURVEY §5.7: the reference's longest
+sequence machinery is a scalar RNN time loop, and the task brief requires
+ring-attention/Ulysses context parallelism as first-class capability).
+
+Built from the same module zoo as every other model: LookupTable embedding,
+sinusoidal positions, ``TransformerEncoder`` (flash-attention capable,
+optionally sequence-sharded via ``seq_axis``), tied to a Linear LM head.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu import nn
+
+
+def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
+             ffn_dim: int = 256, num_layers: int = 2,
+             max_len: int = 1024, dropout: float = 0.0,
+             seq_axis: Optional[str] = None,
+             seq_mode: str = "ring") -> nn.Sequential:
+    """Causal LM: 1-based token ids (N, T) -> log-probs (N, T, vocab).
+
+    ``seq_axis="seq"`` shards every attention layer over the mesh sequence
+    axis (ring attention or Ulysses per ``seq_mode``) — long-context
+    training is a constructor argument, not a different model."""
+    return (nn.Sequential()
+            .add(nn.LookupTable(vocab_size, embed_dim))
+            .add(nn.PositionalEncoding(embed_dim, max_len, dropout))
+            .add(nn.TransformerEncoder(num_layers, embed_dim, num_heads,
+                                       ffn_dim, dropout=dropout, causal=True,
+                                       seq_axis=seq_axis, seq_mode=seq_mode))
+            .add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size)))
+            .add(nn.LogSoftMax()))
