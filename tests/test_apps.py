@@ -168,10 +168,12 @@ class TestPerfHarness:
                            "--synthetic-size", "32", "--checkpoint", ck])
         from bigdl_tpu.utils import file_io
         assert file_io.load(f"{ck}/model_final") is not None
-        # sequence-parallel mode: ring attention over the 8-device mesh
-        transformer.train(["-b", "8", "--seqLen", "32", "-e", "1",
-                           "--synthetic-size", "16",
-                           "--contextParallel", "ring"])
+        # sequence-parallel modes over the 8-device mesh (ulysses requires
+        # num_heads divisible by the seq-axis size)
+        for mode, heads in (("ring", "4"), ("ulysses", "8")):
+            transformer.train(["-b", "8", "--seqLen", "32", "-e", "1",
+                               "--synthetic-size", "16", "--numHeads", heads,
+                               "--contextParallel", mode])
 
     def test_context_parallel_matches_sequential_loss(self):
         # PE offsets + pmean correctness: first-step loss of the seq-parallel

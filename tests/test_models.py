@@ -190,3 +190,15 @@ class TestModelSerialization:
         back.evaluate_mode()
         np.testing.assert_allclose(np.asarray(back.forward(x)), want,
                                    rtol=1e-6, atol=1e-6)
+
+
+class TestTransformerLM:
+    def test_build_lm_shapes(self):
+        from bigdl_tpu.models import transformer
+        model = transformer.build_lm(32, 16, 2, 32, num_layers=2, max_len=64)
+        idx = jnp.ones((2, 10), jnp.float32)
+        out = fwd(model, idx)
+        assert out.shape == (2, 10, 32)
+        # log-probs: rows sum to ~1 in prob space
+        s = np.exp(np.asarray(out)).sum(-1)
+        np.testing.assert_allclose(s, np.ones_like(s), rtol=1e-4)
