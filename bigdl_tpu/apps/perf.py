@@ -21,22 +21,33 @@ import numpy as np
 
 
 _LSTM_VOCAB = 20_000
+_TRANSFORMER_VOCAB = 32_000
 
 
 def _build_model(name: str):
-    """(model, feature_shape, n_classes, int_vocab) — int_vocab > 0 marks
-    integer token-index features (the LSTM text-classification workload,
-    BASELINE config 5 / reference ``models/rnn`` + ``example/textclassification``)."""
-    from bigdl_tpu.models import inception, lenet, resnet, rnn, vgg
+    """(model, feature_shape, n_classes, int_vocab, seq_labels) —
+    ``int_vocab > 0`` marks integer token-index features (LSTM text
+    classification, BASELINE config 5); ``seq_labels`` marks per-timestep
+    targets scored with TimeDistributedCriterion (the causal LM)."""
+    from bigdl_tpu.models import (inception, lenet, resnet, rnn, transformer,
+                                  vgg)
     builders = {
-        "inception_v1": lambda: (inception.build(1000), (224, 224, 3), 1000, 0),
-        "inception_v2": lambda: (inception.build_v2(1000), (224, 224, 3), 1000, 0),
-        "vgg16": lambda: (vgg.build_imagenet(1000, depth=16), (224, 224, 3), 1000, 0),
-        "vgg19": lambda: (vgg.build_imagenet(1000, depth=19), (224, 224, 3), 1000, 0),
-        "resnet50": lambda: (resnet.build(1000, depth=50), (224, 224, 3), 1000, 0),
-        "lenet5": lambda: (lenet.build(10), (28, 28, 1), 10, 0),
+        "inception_v1": lambda: (inception.build(1000), (224, 224, 3), 1000,
+                                 0, False),
+        "inception_v2": lambda: (inception.build_v2(1000), (224, 224, 3),
+                                 1000, 0, False),
+        "vgg16": lambda: (vgg.build_imagenet(1000, depth=16), (224, 224, 3),
+                          1000, 0, False),
+        "vgg19": lambda: (vgg.build_imagenet(1000, depth=19), (224, 224, 3),
+                          1000, 0, False),
+        "resnet50": lambda: (resnet.build(1000, depth=50), (224, 224, 3),
+                             1000, 0, False),
+        "lenet5": lambda: (lenet.build(10), (28, 28, 1), 10, 0, False),
         "lstm": lambda: (rnn.build_classifier(_LSTM_VOCAB, 128, 128, 20),
-                         (500,), 20, _LSTM_VOCAB),
+                         (500,), 20, _LSTM_VOCAB, False),
+        "transformer": lambda: (transformer.build_lm(
+            _TRANSFORMER_VOCAB, 256, 8, 1024, num_layers=4, max_len=2048),
+            (512,), _TRANSFORMER_VOCAB, _TRANSFORMER_VOCAB, True),
     }
     if name not in builders:
         raise SystemExit(f"unknown model {name}; one of {sorted(builders)}")
@@ -66,7 +77,7 @@ def main(argv=None) -> None:
     from bigdl_tpu.utils.logger_filter import redirect_logs
 
     redirect_logs()
-    model, shape, n_class, int_vocab = _build_model(args.model)
+    model, shape, n_class, int_vocab, seq_labels = _build_model(args.model)
 
     rng = np.random.RandomState(0)
     n_records = args.batchSize * 2  # endless shuffled iterator re-serves them
@@ -78,19 +89,26 @@ def main(argv=None) -> None:
     else:
         feats = [rng.randn(*shape).astype(np.float32)
                  for _ in range(n_records)]
-    samples = [Sample(f, np.float32(rng.randint(1, n_class + 1)))
-               for f in feats]
+    if seq_labels:  # per-timestep targets (causal LM next-token loss)
+        samples = [Sample(f, rng.randint(1, n_class + 1,
+                                         shape).astype(np.float32))
+                   for f in feats]
+    else:
+        samples = [Sample(f, np.float32(rng.randint(1, n_class + 1)))
+                   for f in feats]
     ds = DataSet.array(samples).transform(
         SampleToBatch(batch_size=args.batchSize))
 
+    criterion = (nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+                 if seq_labels else nn.ClassNLLCriterion())
     if args.distributed:
         from bigdl_tpu.parallel import MeshTopology
         from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
-        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+        opt = DistriOptimizer(model, ds, criterion,
                               topology=MeshTopology.data_parallel())
     else:
         from bigdl_tpu.optim import Optimizer
-        opt = Optimizer(model, ds, nn.ClassNLLCriterion())
+        opt = Optimizer(model, ds, criterion)
     opt.set_optim_method(SGD(learningrate=0.01))
     if args.precision == "bf16":
         opt.set_precision(DtypePolicy.bf16())

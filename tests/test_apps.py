@@ -255,3 +255,10 @@ class TestPerfHarness:
                                   jnp.asarray(batch.data), training=False)
         loss = float(crit.apply(out, jnp.asarray(batch.labels)))
         assert loss < 2.0, f"LM failed to learn the grammar: {loss}"
+
+    def test_transformer_perf_workload(self, capsys):
+        perf.main(["--model", "transformer", "-b", "2", "-i", "2",
+                   "--warmup", "1", "--precision", "fp32"])
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rec["model"] == "transformer"
+        assert rec["records_per_sec_incl_compile"] > 0
