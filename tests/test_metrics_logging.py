@@ -1,0 +1,68 @@
+"""Metrics counters + logger routing + protobuf wire reader — the three
+modules with no direct test coverage (reference ``optim/Metrics.scala:31``,
+``utils/LoggerFilter.scala:28``, and the wire-walking half of the vendored
+protobuf the reference generates)."""
+
+import logging
+
+import pytest
+
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.utils import protowire
+
+
+class TestMetrics:
+    def test_set_add_value(self):
+        m = Metrics()
+        m.set("computing time average", 0.0, parallel=4)
+        for _ in range(4):
+            m.add("computing time average", 2.0)
+        v, n = m.get("computing time average")
+        assert v == 8.0 and n == 4
+        assert m.value("computing time average") == 2.0
+
+    def test_summary_format(self):
+        m = Metrics()
+        m.add("data wait time", 1.5)
+        s = m.summary()
+        assert "Metrics Summary" in s and "data wait time" in s
+
+    def test_thread_safety(self):
+        import threading
+        m = Metrics()
+
+        def worker():
+            for _ in range(1000):
+                m.add("x", 1.0)
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert m.get("x")[0] == 8000.0
+
+
+class TestLoggerFilter:
+    def test_redirect_routes_chatter_but_keeps_optim(self, tmp_path):
+        from bigdl_tpu.utils.logger_filter import redirect_logs
+        log_file = str(tmp_path / "bigdl.log")
+        redirect_logs(log_file=log_file)
+        logging.getLogger("jax._src.something").info("backend chatter")
+        logging.getLogger("bigdl_tpu.optim").info("iteration line")
+        for h in logging.getLogger().handlers:
+            h.flush()
+        # chatter lands in the file; optim progress stays on the console
+        text = open(log_file).read()
+        assert "backend chatter" in text
+
+
+class TestProtoWire:
+    def test_walk_varint_and_len_fields(self):
+        # field 1 varint 150; field 2 length-delimited b"abc"
+        buf = bytes([0x08, 0x96, 0x01, 0x12, 0x03]) + b"abc"
+        fields = {f: v for f, _, v in protowire.iter_fields(buf)}
+        assert fields[1] == 150
+        assert bytes(fields[2]) == b"abc"
+
+    def test_truncated_raises(self):
+        with pytest.raises(Exception):
+            list(protowire.iter_fields(bytes([0x08, 0x96])))  # varint field, no payload
