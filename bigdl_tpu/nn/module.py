@@ -331,6 +331,42 @@ class Module:
     def n_parameters(self) -> int:
         return sum(int(np.prod(p.shape)) for p in self.parameters())
 
+    def summary(self, max_depth: int = 2) -> str:
+        """Human-readable per-module parameter table (depth-limited), plus
+        totals — a quick structural sanity check before training.
+
+        Examples::
+
+            >>> from bigdl_tpu import nn
+            >>> m = (nn.Sequential().add(nn.Linear(4, 8).set_name("fc1"))
+            ...      .add(nn.ReLU()).add(nn.Linear(8, 2).set_name("fc2")))
+            >>> print(m.summary())  # doctest: +ELLIPSIS
+            Sequential...
+            ...fc1...40
+            ...fc2...18
+            ...
+            Total parameters: 58
+        """
+        lines = []
+
+        def walk(mod, depth, label):
+            collapsed = depth >= max_depth or not mod._modules
+            count = mod.n_parameters() if collapsed else sum(
+                int(np.prod(p.shape)) for p in mod._parameters.values())
+            lines.append(f"{'  ' * depth}{label} ({type(mod).__name__})"
+                         .ljust(52) + f"{count:>12,}")
+            if depth < max_depth:
+                for key, child in mod._modules.items():
+                    # registry key distinguishes default-named siblings
+                    label = child.name if child.name != type(child).__name__ \
+                        else f"{key}:{child.name}"
+                    walk(child, depth + 1, label)
+
+        walk(self, 0, self.name)
+        lines.append("-" * 64)
+        lines.append(f"Total parameters: {self.n_parameters():,}")
+        return "\n".join(lines)
+
     def zero_grad_parameters(self) -> None:
         """No-op: gradients are values returned by ``jax.grad``, never state."""
 

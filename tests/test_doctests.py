@@ -8,6 +8,7 @@ import pytest
 
 import bigdl_tpu.dataset.base
 import bigdl_tpu.nn.containers
+import bigdl_tpu.nn.module
 import bigdl_tpu.optim.optimizer
 import bigdl_tpu.optim.triggers
 import bigdl_tpu.tensor.tensor
@@ -15,6 +16,7 @@ import bigdl_tpu.tensor.tensor
 MODULES = [
     bigdl_tpu.tensor.tensor,
     bigdl_tpu.nn.containers,
+    bigdl_tpu.nn.module,
     bigdl_tpu.dataset.base,
     bigdl_tpu.optim.triggers,
     bigdl_tpu.optim.optimizer,
