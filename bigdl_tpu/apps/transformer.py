@@ -104,6 +104,11 @@ def _train_context_parallel(model, criterion, ds, args):
     if args.summary:
         log.warning("--summary is ignored with --contextParallel")
     n = len(jax.devices())
+    if args.seqLen % n != 0:
+        raise SystemExit(
+            f"--seqLen {args.seqLen} is not divisible by the device count "
+            f"{n}: sequence parallelism shards the sequence axis evenly "
+            "across devices; pick a multiple")
     mesh = MeshTopology(sequence=n).build()
     method = SGD(learningrate=args.learningRate,
                  learningrate_decay=args.learningRateDecay,

@@ -131,10 +131,18 @@ def read_shard(path: str) -> Iterator[ByteRecord]:
     if scanned is not None:
         buf, index = scanned
         for off, length in index:
+            if length < 4:
+                raise IOError(
+                    f"record shorter than its 4-byte label ({length}B) in "
+                    f"{path}")
             (label,) = struct.unpack_from("<f", buf, off)
             yield ByteRecord(buf[off + 4:off + length], label)
         return
     for record in FileReader.read_records(path):
+        if len(record) < 4:
+            raise IOError(
+                f"record shorter than its 4-byte label ({len(record)}B) in "
+                f"{path}")
         (label,) = struct.unpack("<f", record[:4])
         yield ByteRecord(record[4:], label)
 

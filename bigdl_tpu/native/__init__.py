@@ -142,12 +142,21 @@ def load(force_rebuild: bool = False) -> Optional[ctypes.CDLL]:
                     # mapping under the original path for this process
                     logger.info("native library at %s is stale/unloadable "
                                 "(%s); rebuilding", path, e)
+                    stale_path = path
                     path = _compile(unique=True)
                     if path is not None:
                         try:
                             lib = _bind(path)
                         except (OSError, AttributeError) as e2:
                             logger.warning("native rebuild failed: %s", e2)
+                        else:
+                            # Replace the stale base .so so later processes
+                            # load the fixed library directly instead of each
+                            # repeating the AttributeError + full rebuild.
+                            try:
+                                os.replace(path, stale_path)
+                            except OSError:
+                                pass
                 else:
                     logger.warning("native library load failed: %s", e)
         return lib

@@ -21,10 +21,29 @@ from bigdl_tpu.nn.module import TensorModule
 
 
 def use_fused_1x1() -> bool:
-    """The builders' shared opt-in gate (``BIGDL_TPU_FUSED_1X1=1``)."""
+    """The builders' shared opt-in gate (``BIGDL_TPU_FUSED_1X1=1``).
+
+    Single-chip only: ``pallas_call`` has no GSPMD partitioning rule, so
+    inside DistriOptimizer's sharded jitted step XLA would force
+    replication/all-gather of the activations. Warns once when enabled
+    with more than one visible device."""
     import os
-    return os.environ.get("BIGDL_TPU_FUSED_1X1", "").strip().lower() \
+    on = os.environ.get("BIGDL_TPU_FUSED_1X1", "").strip().lower() \
         in ("1", "true", "yes")
+    if on and not use_fused_1x1._warned:
+        # No jax.device_count() probe here: builders run before Engine.init,
+        # and touching the device API would initialize the backend too early
+        # (breaking jax.distributed bring-up and CPU-forcing workflows).
+        use_fused_1x1._warned = True
+        import logging
+        logging.getLogger("bigdl_tpu.nn").info(
+            "BIGDL_TPU_FUSED_1X1 is a single-chip optimisation: the Pallas "
+            "kernel has no SPMD partitioning rule and forces activation "
+            "replication if used inside a sharded (multi-device) step")
+    return on
+
+
+use_fused_1x1._warned = False
 
 
 class FusedConv1x1BN(TensorModule):

@@ -6,8 +6,12 @@ parallelism: ABSENT". ``MoE`` is its distributed descendant, built the
 GShard/Switch way for TPU:
 
 - top-k softmax gating with capacity limiting;
-- dense dispatch/combine einsums (token, expert, capacity) — XLA-friendly
-  static shapes, no gather/scatter;
+- ragged scatter/gather dispatch (default): tokens scatter-add into the
+  (expert, capacity, d) buffers and gather back by (expert, slot) index —
+  static shapes, O(E·C·D) memory instead of the dense (T, E, C)
+  dispatch/combine masks, which dominate memory at real token counts;
+  ``dispatch="einsum"`` keeps the dense GShard-paper formulation for
+  comparison/debug;
 - expert FFN weights STACKED on a leading expert axis; under expert
   parallelism those leaves are sharded ``P('expert', ...)`` and GSPMD turns
   the dispatch einsums into all_to_alls over the mesh ``expert`` axis —
@@ -64,8 +68,12 @@ class MoE(Module):
 
     def __init__(self, input_size: int, hidden_size: int, n_experts: int,
                  k: int = 2, capacity_factor: float = 1.25,
-                 activation: str = "gelu", aux_loss_weight: float = 1e-2):
+                 activation: str = "gelu", aux_loss_weight: float = 1e-2,
+                 dispatch: str = "scatter"):
         super().__init__()
+        if dispatch not in ("scatter", "einsum"):
+            raise ValueError(f"dispatch must be 'scatter' or 'einsum', "
+                             f"got {dispatch!r}")
         self.input_size = input_size
         self.hidden_size = hidden_size
         self.n_experts = n_experts
@@ -73,6 +81,7 @@ class MoE(Module):
         self.capacity_factor = capacity_factor
         self.activation = activation
         self.aux_loss_weight = aux_loss_weight
+        self.dispatch = dispatch
         d, h, e = input_size, hidden_size, n_experts
         self.register_parameter("gate_weight", init.xavier((d, e), d, e))
         self.register_parameter(
@@ -96,13 +105,13 @@ class MoE(Module):
         logits = x @ self.gate_weight                      # (T, E)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
-        # Iterative top-k: k one-hot picks with renormalised weights.
-        dispatch = jnp.zeros((t, e, capacity), jnp.float32)
-        combine = jnp.zeros((t, e, capacity), jnp.float32)
+        # Iterative top-k routing metadata: O(T·E) position bookkeeping
+        # (running per-expert counts), never a (T, E, C) tensor. Slots
+        # already used per expert accumulate across the k picks.
         masked = probs
-        # Slots already used per expert accumulate across the k picks.
         fill = jnp.zeros((e,), jnp.int32)
         topk_mask = jnp.zeros_like(probs)
+        picks = []  # (expert (T,), slot (T,), gate weight w/ drops zeroed)
         for _ in range(k):
             pick = jnp.argmax(masked, axis=-1)             # (T,)
             onehot = jax.nn.one_hot(pick, e, dtype=jnp.float32)
@@ -113,24 +122,53 @@ class MoE(Module):
             pos_t = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # (T,)
             keep = pos_t < capacity
             w = jnp.sum(probs * onehot, axis=-1) * keep    # (T,)
-            slot = jax.nn.one_hot(pos_t, capacity, dtype=jnp.float32)
-            dc = onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]
-            dispatch = dispatch + dc
-            combine = combine + dc * w[:, None, None]
-            fill = fill + jnp.sum(onehot * keep[:, None], axis=0).astype(jnp.int32)
+            picks.append((pick, jnp.where(keep, pos_t, 0), keep, w))
+            fill = fill + jnp.sum(onehot * keep[:, None],
+                                  axis=0).astype(jnp.int32)
             masked = masked * (1.0 - onehot)
 
-        # Renormalise the k gate weights so they sum to 1 per token.
-        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
-        combine = combine / jnp.maximum(denom, 1e-9)
+        # Renormalise the k kept gate weights to sum 1 per token, then
+        # rescale by the FULL top-k probability mass (drops included) —
+        # GShard combine semantics.
+        denom = sum(w for _, _, _, w in picks)             # (T,)
         scale = jnp.sum(probs * topk_mask, axis=-1)        # (T,)
-        combine = combine * scale[:, None, None]
+        coef = scale / jnp.maximum(denom, 1e-9)
 
-        xe = jnp.einsum("tec,td->ecd", dispatch, x)        # (E, C, D)
+        xf = x.astype(jnp.float32)
+        if self.dispatch == "scatter":
+            # Ragged dispatch: dropped picks have w=0 and slot clamped to 0,
+            # so their scatter contribution is zeroed and their gather-back
+            # is weighted out.
+            xe = jnp.zeros((e, capacity, d), jnp.float32)
+            for pick, slot, keep, _ in picks:
+                xe = xe.at[pick, slot].add(
+                    xf * keep[:, None].astype(jnp.float32))
+        else:
+            dispatch_t = jnp.zeros((t, e, capacity), jnp.float32)
+            for pick, slot, keep, _ in picks:
+                dc = (jax.nn.one_hot(pick, e)[:, :, None]
+                      * jax.nn.one_hot(slot, capacity)[:, None, :]
+                      * keep[:, None, None])
+                dispatch_t = dispatch_t + dc
+            xe = jnp.einsum("tec,td->ecd", dispatch_t, xf)  # (E, C, D)
+
         hdn = self._act(jnp.einsum("ecd,edh->ech", xe, self.w1)
                         + self.b1[:, None, :])
         ye = jnp.einsum("ech,ehd->ecd", hdn, self.w2) + self.b2[:, None, :]
-        y = jnp.einsum("tec,ecd->td", combine, ye).astype(input.dtype)
+
+        if self.dispatch == "scatter":
+            y = jnp.zeros((t, d), jnp.float32)
+            for pick, slot, _, w in picks:
+                y = y + (w * coef)[:, None] * ye[pick, slot]
+            y = y.astype(input.dtype)
+        else:
+            combine = jnp.zeros((t, e, capacity), jnp.float32)
+            for pick, slot, keep, w in picks:
+                dc = (jax.nn.one_hot(pick, e)[:, :, None]
+                      * jax.nn.one_hot(slot, capacity)[:, None, :]
+                      * keep[:, None, None])
+                combine = combine + dc * (w * coef)[:, None, None]
+            y = jnp.einsum("tec,ecd->td", combine, ye).astype(input.dtype)
 
         if self.aux_loss_weight and self.training:
             # Switch-style load balance: E * sum_e f_e * p_e.

@@ -31,6 +31,7 @@ class _EngineState:
     def __init__(self) -> None:
         self.initialized = False
         self.dist_checked = False
+        self.env_warned: set = set()
         self.node_number = 1
         self.core_number = 1
         self._devices = None
@@ -87,7 +88,7 @@ class Engine:
         (reference ``bigdl.disableCheckSysEnv``)."""
         problems: List[str] = []
         disable = os.environ.get("BIGDL_TPU_DISABLE_ENV_CHECK", "")
-        if disable.strip().lower() not in ("", "0", "false", "no"):
+        if disable.strip().lower() in ("1", "true", "yes", "y", "on"):
             return problems
         if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
             problems.append(
@@ -102,8 +103,12 @@ class Engine:
                 f"OMP_NUM_THREADS={omp or '<unset>'}: host BLAS/OpenMP "
                 "threads fight the data-pipeline IO pool; the launcher "
                 "pins it to 1 (reference spark-bigdl.conf OMP_NUM_THREADS=1)")
+        # warn once per process per complaint — library-style users re-init
+        # Engine freely and should not see the same nag every time
         for p in problems:
-            logger.warning("[Engine.check_env] %s", p)
+            if p not in _state.env_warned:
+                _state.env_warned.add(p)
+                logger.warning("[Engine.check_env] %s", p)
         if strict and problems:
             raise RuntimeError("launch environment check failed:\n  "
                                + "\n  ".join(problems))

@@ -255,3 +255,49 @@ def _gcs_mtime(path: str) -> float:
 
 register_scheme("gs", _gcs_opener, lister=_gcs_lister, mtime=_gcs_mtime,
                 exists=lambda p: _gcs_blob(p).exists())
+
+
+# ---------------------------------------------------------- hdfs:// handler
+# The reference's actual remote scheme (``File.scala:27`` ``hdfsPrefix``):
+# a migrating user's ``hdfs://namenode:port/...`` checkpoint path must not
+# die with "unknown scheme". Backed by fsspec -> pyarrow HadoopFileSystem;
+# needs libhdfs + a Hadoop client config on the host. On a TPU pod the
+# native substrate is ``gs://`` — the error message says so.
+
+def _hdfs_fs_path(path: str):
+    try:
+        import fsspec
+        return fsspec.core.url_to_fs("hdfs://" + path)
+    except Exception as e:
+        raise RuntimeError(
+            "hdfs:// checkpoint IO needs a working Hadoop client "
+            "(fsspec -> pyarrow HadoopFileSystem, which loads libhdfs and "
+            "reads HADOOP_HOME/CLASSPATH); on TPU the native remote store "
+            "is gs:// — or file_io.register_scheme('hdfs', ...) your own "
+            f"opener: {e}") from e
+
+
+def _hdfs_opener(path: str, mode: str):
+    fs, p = _hdfs_fs_path(path)
+    return fs.open(p, mode)
+
+
+def _hdfs_lister(path: str) -> List[str]:
+    fs, p = _hdfs_fs_path(path)
+    return sorted(name.rstrip("/").rsplit("/", 1)[-1]
+                  for name in fs.ls(p, detail=False))
+
+
+def _hdfs_mtime(path: str) -> float:
+    fs, p = _hdfs_fs_path(path)
+    mt = fs.info(p).get("mtime") or 0.0
+    return mt.timestamp() if hasattr(mt, "timestamp") else float(mt)
+
+
+def _hdfs_exists(path: str) -> bool:
+    fs, p = _hdfs_fs_path(path)
+    return fs.exists(p)
+
+
+register_scheme("hdfs", _hdfs_opener, lister=_hdfs_lister,
+                mtime=_hdfs_mtime, exists=_hdfs_exists)

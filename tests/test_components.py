@@ -135,6 +135,22 @@ class TestShards:
         open(cut_path, "wb").write(blob[:-9])
         assert len(list(read_shard(cut_path))) == 9
 
+    def test_record_shorter_than_label_is_ioerror_both_paths(
+            self, tmp_path, monkeypatch):
+        # a CRC-valid record whose payload is < 4 bytes cannot carry a label;
+        # native scan and the pure-Python fallback must BOTH raise IOError
+        # (not silently read CRC bytes as the label / not struct.error)
+        from bigdl_tpu.dataset import shards as sh
+        from bigdl_tpu.visualization.tensorboard import RecordWriter
+        path = str(tmp_path / "short.bigdl-shard")
+        with open(path, "wb") as f:
+            RecordWriter(f).write(b"ab")
+        with pytest.raises(IOError, match="4-byte label"):
+            list(read_shard(path))
+        monkeypatch.setattr(sh, "_native_scan", lambda p: None)
+        with pytest.raises(IOError, match="4-byte label"):
+            list(read_shard(path))
+
 
 class TestModelBroadcast:
     def test_value_device_resident(self):

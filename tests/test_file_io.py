@@ -46,7 +46,14 @@ class TestSchemes:
 
     def test_unregistered_scheme_rejected(self):
         with pytest.raises(ValueError, match="no handler registered"):
-            file_io.save(1, "hdfs://nn/ckpt")
+            file_io.save(1, "s3://nn/ckpt")
+
+    def test_hdfs_registered_and_explicit_without_cluster(self):
+        # the reference's own scheme (File.scala:27 hdfsPrefix) must not die
+        # with "unknown scheme"; with no Hadoop client on this host the
+        # error says what to configure and names the gs:// alternative
+        with pytest.raises(RuntimeError, match="Hadoop|gs://"):
+            file_io.load("hdfs://namenode:9000/ckpt/model.1")
 
     def test_gs_unconfigured_is_explicit(self):
         # the client lib exists here but no credentials do: the error must
