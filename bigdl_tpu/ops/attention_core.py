@@ -181,12 +181,14 @@ def online_softmax_combine(acc_a, sum_a, max_a, acc_b, sum_b, max_b):
 
 
 def attention_partial(q, k, v, scale, k_offset, q_offset, causal,
-                      kv_valid_len=None):
+                      kv_valid_len=None, q_pos=None, k_pos=None):
     """Unnormalised attention of q against one key/value chunk.
 
     Returns (acc, row_sum, row_max) suitable for ``online_softmax_combine``.
     ``k_offset``/``q_offset`` are the global positions of the chunks'
-    first elements (needed for causal masking across devices).
+    first elements (needed for causal masking across devices). For
+    non-contiguous layouts (zigzag ring shards) pass explicit ``q_pos``/
+    ``k_pos`` global-position vectors instead — they override the offsets.
     """
     b, sq, n, d = q.shape
     sk = k.shape[1]
@@ -197,8 +199,10 @@ def attention_partial(q, k, v, scale, k_offset, q_offset, causal,
         valid = jnp.arange(sk) < kv_valid_len
         logits = jnp.where(valid[None, None, None, :], logits, neg)
     if causal:
-        q_pos = q_offset + jnp.arange(sq)
-        k_pos = k_offset + jnp.arange(sk)
+        if q_pos is None:
+            q_pos = q_offset + jnp.arange(sq)
+        if k_pos is None:
+            k_pos = k_offset + jnp.arange(sk)
         cm = k_pos[None, :] <= q_pos[:, None]
         logits = jnp.where(cm[None, None], logits, neg)
     rmax = jnp.max(logits, axis=-1)                      # (B,N,Sq)

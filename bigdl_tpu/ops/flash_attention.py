@@ -1,30 +1,39 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
 New capability (no reference analogue — the reference's hottest hand-written
 loops are im2col/col2im, ``nn/NNPrimitive.scala``; this is the TPU build's
-equivalent "hand kernel" for its hottest new op). The kernel implements the
-online-softmax attention forward tiled for VMEM:
+equivalent "hand kernel" for its hottest new op). Three kernels:
 
-- grid = (batch*heads, query blocks); each program holds one query tile in
-  VMEM and streams key/value tiles for its (batch, head) row;
-- running (acc, row_sum, row_max) carried in f32 on the VPU, the two matmuls
-  per tile hit the MXU;
-- causal masking skips fully-masked key tiles (no FLOPs spent above the
-  diagonal).
+- forward: online-softmax attention tiled for VMEM. grid = (batch*heads,
+  query blocks); each program holds one query tile resident and streams
+  key/value tiles for its (batch, head) row; running (acc, row_sum,
+  row_max) carried in f32 on the VPU, the two matmuls per tile hit the
+  MXU; causal masking skips fully-masked key tiles. Emits the row
+  logsumexp (LSE) alongside the output — the residual the backward needs,
+  and the statistic ring attention folds across devices.
+- backward dQ: grid over query tiles; recomputes p = exp(logits - lse)
+  per key tile (no O(S^2) materialisation) and accumulates
+  dq += (p * (dO v^T - delta)) k * scale.
+- backward dK/dV: grid over key tiles; streams query tiles, accumulating
+  dv += p^T dO and dk += (p * (dO v^T - delta))^T q * scale. Causal runs
+  start at the diagonal query tile.
 
-Backward uses recomputation: a ``jax.custom_vjp`` whose bwd re-runs the
-memory-light blockwise XLA formulation under ``jax.checkpoint`` semantics
-(FLOPs traded for HBM, the standard flash training recipe).
+The LSE output is a first-class differentiable output: its cotangent folds
+into the delta term (d lse_i / d logits_ij = p_ij, so delta_i becomes
+rowsum(dO_i * O_i) - g_lse_i). Ring attention exploits exactly this to
+backprop through cross-device online-softmax combines.
 
-On CPU the same kernel runs in Pallas interpret mode (tests); dispatch via
-``use_flash`` only selects it on real TPU backends by default.
+On CPU the kernels run in Pallas interpret mode (tests); dispatch via
+``use_flash`` selects the kernel on real TPU backends.
+``BIGDL_TPU_FLASH_XLA_BWD=1`` falls back to the recompute-via-XLA backward
+(A/B lever; it was the only backward before round 3).
 """
 
 from __future__ import annotations
 
 import functools
 import os
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,9 +43,12 @@ from jax.experimental import pallas as pl
 _NEG = float(jnp.finfo(jnp.float32).min)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, sk: int,
+# ------------------------------------------------------------------ forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int, sk: int,
                 causal: bool, scale: float, block_q: int):
-    # q_ref: (1, BQ, D); k_ref/v_ref: (1, Sk_pad, D); o_ref: (1, BQ, D)
+    # q_ref: (1, BQ, D); k_ref/v_ref: (1, Sk_pad, D); o_ref: (1, BQ, D);
+    # l_ref: (1, BQ) row logsumexp of the scaled, masked logits.
     j = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale                # (BQ, D)
     bq, d = q.shape
@@ -76,12 +88,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, sk: int,
     acc0 = jnp.zeros((bq, d), jnp.float32)
     sum0 = jnp.zeros((bq,), jnp.float32)
     max0 = jnp.full((bq,), _NEG, jnp.float32)
-    acc, rsum, _ = lax.fori_loop(0, nkb_eff, body, (acc0, sum0, max0))
-    rsum = jnp.maximum(rsum, 1e-37)
-    o_ref[0] = (acc / rsum[:, None]).astype(o_ref.dtype)
+    acc, rsum, rmax = lax.fori_loop(0, nkb_eff, body, (acc0, sum0, max0))
+    dead = rmax <= _NEG / 2
+    rsum_safe = jnp.maximum(rsum, 1e-37)
+    o_ref[0] = (acc / rsum_safe[:, None]).astype(o_ref.dtype)
+    # Dead rows keep the finite _NEG sentinel (NOT -inf): downstream
+    # logaddexp-style combines stay NaN-free on all-masked rows.
+    l_ref[0] = jnp.where(dead, _NEG, rmax + jnp.log(rsum_safe))
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Returns (o (B,Sq,N,D), lse (B,N,Sq) f32)."""
     b, sq, n, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -100,60 +117,259 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     sq_p, sk_p = qt.shape[1], kt.shape[1]
 
     grid = (b * n, sq_p // block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, block_k=block_k, sk=sk,
                           causal=causal, scale=scale, block_q=block_q),
-        out_shape=jax.ShapeDtypeStruct((b * n, sq_p, d), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((b * n, sq_p, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * n, sq_p), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, sk_p, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, sk_p, d), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=(pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                   pl.BlockSpec((1, block_q), lambda i, j: (i, j))),
         interpret=interpret,
     )(qt, kt, vt)
     out = out[:, :sq].reshape(b, n, sq, d).transpose(0, 2, 1, 3)
-    return out
+    lse = lse[:, :sq].reshape(b, n, sq)
+    return out, lse
 
+
+# ----------------------------------------------------------------- backward
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, dq_ref, *,
+                   block_k: int, sk: int, causal: bool, scale: float,
+                   block_q: int):
+    # Per query tile: stream key tiles, recompute p from the saved LSE.
+    j = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                        # (BQ, D)
+    do = do_ref[0].astype(jnp.float32)                      # (BQ, D)
+    lse = l_ref[0]                                          # (BQ,)
+    delta = d_ref[0]                                        # (BQ,)
+    bq, d = q.shape
+    nkb = k_ref.shape[1] // block_k
+    q_pos = j * block_q + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(kb, dq):
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        logits = jnp.dot(q, kblk.T,
+                         preferred_element_type=jnp.float32) * scale
+        k_pos = kb * block_k + lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        valid = k_pos < sk
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        # exp(_NEG sentinel rows - _NEG) would be 1; the valid mask zeroes
+        # them, so dead rows contribute nothing — no NaN path.
+        p = jnp.where(valid, jnp.exp(logits - lse[:, None]), 0.0)
+        dp = jnp.dot(do, vblk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jnp.dot(ds, kblk, preferred_element_type=jnp.float32)
+
+    if causal:
+        last_q = j * block_q + bq - 1
+        nkb_eff = lax.min(nkb, lax.div(last_q, block_k) + 1)
+    else:
+        nkb_eff = nkb
+    dq = lax.fori_loop(0, nkb_eff, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref,
+                    dk_ref, dv_ref, *, block_q: int, sk: int, sq: int,
+                    causal: bool, scale: float, block_k: int):
+    # Per key tile: stream query tiles. Padded query rows carry dO = 0 and
+    # delta = 0, so they contribute nothing.
+    jkb = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                        # (BK, D)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    nqb = q_ref.shape[1] // block_q
+    k_pos = jkb * block_k + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(qb, carry):
+        dk, dv = carry
+        qblk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        doblk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lblk = l_ref[0, pl.ds(qb * block_q, block_q)]       # (BQ,)
+        dblk = d_ref[0, pl.ds(qb * block_q, block_q)]       # (BQ,)
+        logits = jnp.dot(qblk, k.T,
+                         preferred_element_type=jnp.float32) * scale
+        q_pos = qb * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        valid = k_pos < sk
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        p = jnp.where(valid, jnp.exp(logits - lblk[:, None]), 0.0)  # (BQ,BK)
+        dv = dv + jnp.dot(p.T, doblk, preferred_element_type=jnp.float32)
+        dp = jnp.dot(doblk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dblk[:, None]) * scale
+        dk = dk + jnp.dot(ds.T, qblk, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # Query tiles strictly before this key tile's first row see none of
+        # its keys.
+        first_qb = lax.div(jkb * block_k, block_q)
+    else:
+        first_qb = 0
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = lax.fori_loop(first_qb, nqb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g_o, g_l, causal, scale, block_q, block_k,
+               interpret):
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * n, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * n, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * n, sk, d)
+    dot = g_o.transpose(0, 2, 1, 3).reshape(b * n, sq, d)
+    ot = o.transpose(0, 2, 1, 3).reshape(b * n, sq, d)
+    lt = lse.reshape(b * n, sq)
+    # delta_i = rowsum(dO_i * O_i) - g_lse_i (the LSE cotangent enters the
+    # softmax jacobian exactly where the diagonal correction sits).
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1)
+    if g_l is not None:
+        delta = delta - g_l.reshape(b * n, sq).astype(jnp.float32)
+
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, pad_q), (0, 0)))
+        dot = jnp.pad(dot, ((0, 0), (0, pad_q), (0, 0)))
+        lt = jnp.pad(lt, ((0, 0), (0, pad_q)), constant_values=_NEG)
+        delta = jnp.pad(delta, ((0, 0), (0, pad_q)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, pad_k), (0, 0)))
+    sq_p, sk_p = qt.shape[1], kt.shape[1]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, sk=sk,
+                          causal=causal, scale=scale, block_q=block_q),
+        out_shape=jax.ShapeDtypeStruct((b * n, sq_p, d), q.dtype),
+        grid=(b * n, sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lt, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, sk=sk, sq=sq,
+                          causal=causal, scale=scale, block_k=block_k),
+        out_shape=(jax.ShapeDtypeStruct((b * n, sk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * n, sk_p, d), v.dtype)),
+        grid=(b * n, sk_p // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sq_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq_p), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, sq_p), lambda i, j: (i, 0)),
+        ],
+        out_specs=(pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+                   pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lt, delta)
+
+    dq = dq[:, :sq].reshape(b, n, sq, d).transpose(0, 2, 1, 3)
+    dk = dk[:, :sk].reshape(b, n, sk, d).transpose(0, 2, 1, 3)
+    dv = dv[:, :sk].reshape(b, n, sk, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------ differentiable core
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_fwd_lse(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret), (q, k, v)
+def _flash_lse_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    from bigdl_tpu.ops.attention_core import blockwise_attention
-    q, k, v = res
-    f = lambda q_, k_, v_: blockwise_attention(
-        q_, k_, v_, causal=causal, scale=scale, block_size=block_k)
-    _, vjp = jax.vjp(jax.checkpoint(f), q, k, v)
-    return vjp(g)
+def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    g_o, g_l = g
+    q, k, v, o, lse = res
+    if os.environ.get("BIGDL_TPU_FLASH_XLA_BWD"):
+        # Pre-round-3 recompute path (A/B lever). Has no LSE cotangent
+        # plumbing — valid only when nothing consumes lse downstream.
+        from bigdl_tpu.ops.attention_core import blockwise_attention
+        f = lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, scale=scale, block_size=block_k)
+        _, vjp = jax.vjp(jax.checkpoint(f), q, k, v)
+        return vjp(g_o)
+    return _flash_bwd(q, k, v, o, lse, g_o, g_l, causal, scale,
+                      block_q, block_k, interpret)
 
 
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
+
+# ------------------------------------------------------------- public entry
 
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = 256, block_k: int = 256,
                     interpret: Optional[bool] = None) -> jax.Array:
-    """Flash attention, shapes (B, S, N, D); differentiable."""
+    """Flash attention, shapes (B, S, N, D); differentiable (Pallas fwd+bwd)."""
     if scale is None:
         scale = 1.0 / float(q.shape[-1]) ** 0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    o, _ = _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def flash_attention_with_lse(
+        q, k, v, causal: bool = False, scale: Optional[float] = None,
+        block_q: int = 256, block_k: int = 256,
+        interpret: Optional[bool] = None) -> Tuple[jax.Array, jax.Array]:
+    """Flash attention returning ``(o (B,S,N,D), lse (B,N,S) f32)``.
+
+    The LSE is differentiable (its cotangent folds into the softmax
+    jacobian), which is what lets ring attention run this kernel per hop
+    and still train: the cross-device combine consumes both outputs.
+    All-masked rows carry the finite ``float32.min`` sentinel, not -inf.
+    """
+    if scale is None:
+        scale = 1.0 / float(q.shape[-1]) ** 0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
 def use_flash(q, mask) -> bool:
     """Dispatch policy for MultiHeadAttention: Pallas kernel on real TPU for
-    long unmasked sequences (masked paths use the XLA cores which take an
-    arbitrary additive bias)."""
+    unmasked sequences (masked paths use the XLA cores which take an
+    arbitrary additive bias).
+
+    Gate (retuned in round 3 so benchmarked configs actually dispatch): the
+    kernel handles any seq (it pads to the block size) and any lane-friendly
+    head dim; below 256 positions the XLA fused softmax is already fine and
+    kernel launch overhead wins nothing.
+    """
     if os.environ.get("BIGDL_TPU_DISABLE_FLASH"):
         return False
     if mask is not None:
@@ -161,4 +377,4 @@ def use_flash(q, mask) -> bool:
     if jax.default_backend() != "tpu":
         return False
     seq, d = q.shape[1], q.shape[-1]
-    return seq >= 512 and d % 128 == 0 and seq % 128 == 0
+    return seq >= 256 and d % 64 == 0
