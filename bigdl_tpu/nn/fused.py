@@ -46,6 +46,15 @@ def use_fused_1x1() -> bool:
 use_fused_1x1._warned = False
 
 
+def use_fused_3x3() -> bool:
+    """Opt-in gate for the 3x3 fusion (``BIGDL_TPU_FUSED_3X3=1``).
+
+    Same single-chip caveat as ``use_fused_1x1``."""
+    import os
+    return os.environ.get("BIGDL_TPU_FUSED_3X3", "").strip().lower() \
+        in ("1", "true", "yes")
+
+
 class FusedConv1x1BN(TensorModule):
     """1x1 conv + batch norm as ONE module (reference pair:
     ``SpatialConvolution(k=1)`` + ``SpatialBatchNormalization``): training
@@ -115,3 +124,49 @@ class FusedConv1x1BN(TensorModule):
     def __repr__(self):
         return (f"FusedConv1x1BN({self.n_input_plane} -> "
                 f"{self.n_output_plane}, stride={self.stride})")
+
+
+class FusedConv3x3BN(TensorModule):
+    """3x3 SAME-padded stride-1 conv + batch norm as ONE module (reference
+    pair: ``SpatialConvolution(k=3, pad=1)`` + ``SpatialBatchNormalization``):
+    training forward runs the one-pass Pallas conv+stats kernel
+    (``ops/conv3x3_bn.py``); eval folds BN into the conv weights and runs a
+    single XLA convolution."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 eps: float = 1e-5, momentum: float = 0.1,
+                 init_method: str = "kaiming"):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.eps, self.momentum = eps, momentum
+        fan_in = 9 * n_input_plane
+        self.register_parameter(
+            "weight", init.conv_weight(init_method,
+                                       (3, 3, n_input_plane, n_output_plane),
+                                       fan_in, 9 * n_output_plane))
+        self.register_parameter("gamma", init.ones((n_output_plane,)))
+        self.register_parameter("beta", init.zeros((n_output_plane,)))
+        self.register_buffer("running_mean", init.zeros((n_output_plane,)))
+        self.register_buffer("running_var", init.ones((n_output_plane,)))
+
+    def update_output(self, input):
+        if self.training:
+            from bigdl_tpu.nn.normalization import blend_running_stats
+            from bigdl_tpu.ops.conv3x3_bn import conv3x3_bn_train
+            out, mean, var = conv3x3_bn_train(input, self.weight, self.gamma,
+                                              self.beta, self.eps)
+            n, h, w, _ = input.shape
+            blend_running_stats(self, mean, var, n * h * w, self.momentum)
+            return out
+        # inference: fold normalize into the taps, one conv, no extra pass
+        from bigdl_tpu.ops.conv3x3_bn import _conv3x3
+        inv = jax.lax.rsqrt(self.running_var + self.eps)
+        scale = (self.gamma * inv).astype(jnp.float32)
+        w_folded = (self.weight.astype(jnp.float32) * scale).astype(
+            input.dtype)
+        shift = self.beta - self.running_mean * scale
+        return _conv3x3(input, w_folded) + shift.astype(input.dtype)
+
+    def __repr__(self):
+        return (f"FusedConv3x3BN({self.n_input_plane} -> "
+                f"{self.n_output_plane})")

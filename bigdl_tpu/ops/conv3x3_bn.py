@@ -1,0 +1,177 @@
+"""Fused 3x3-conv (stride 1, SAME) + batch-norm statistics — the Pallas
+kernel that extends the conv+BN epilogue fusion past 1x1 convs.
+
+PERF.md's bandwidth analysis: after the 1x1 fusion the remaining avoidable
+HBM traffic is the BN-stats re-read of every 3x3 conv output — and the 3x3
+convs carry the majority of ResNet-50's FLOPs. This kernel computes, in ONE
+pass over the input,
+
+    y = conv3x3(x, w)         (stride 1, SAME padding)
+    col_sum[c]   = sum_{n,h,w} y[n,h,w,c]
+    col_sumsq[c] = sum_{n,h,w} y[n,h,w,c]^2
+
+Convolution as 9 shifted matmuls: for each tap (dy, dx), a
+(H*W, Cin) @ (Cin, Cout) matmul on the MXU accumulating into the f32 output
+tile — the TPU-native descendant of the reference's im2col
+(``nn/NNPrimitive.scala:24``), except the "column" matrix is never
+materialised: taps are VMEM slices of a zero-padded scratch copy of the
+image. SAME padding happens IN VMEM (a scratch buffer per grid step), so no
+padded copy of x ever hits HBM — padding in XLA would cost a full
+read+write of x and erase the fusion's bandwidth win.
+
+Grid = (N,): one image per step, weights and the (1, Cout) stat
+accumulators resident across the sweep (their index maps are constant), the
+next image's DMA overlapping the current matmuls. Every ResNet-50 3x3
+layer's per-step footprint fits VMEM (largest: 56x56x64 at ~2.6 MB f32).
+
+Correctness is interpret-mode tested on CPU (tests/test_conv3x3_bn.py);
+dispatch is gated like the 1x1 fusion (``BIGDL_TPU_FUSED_3X3``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, w_ref, y_ref, sum_ref, sq_ref, scratch, *,
+            h: int, w: int, cout: int):
+    n = pl.program_id(0)
+    # SAME padding in VMEM: zero the halo, copy the image into the interior.
+    scratch[...] = jnp.zeros_like(scratch)
+    scratch[1:h + 1, 1:w + 1, :] = x_ref[0]
+
+    acc = jnp.zeros((h * w, cout), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            xs = scratch[dy:dy + h, dx:dx + w, :].reshape(h * w, -1)
+            acc = acc + jnp.dot(xs, w_ref[dy * 3 + dx],
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(n == 0)
+    def _zero():
+        sum_ref[...] = jnp.zeros_like(sum_ref)
+        sq_ref[...] = jnp.zeros_like(sq_ref)
+
+    sum_ref[...] += jnp.sum(acc, axis=0, keepdims=True)
+    sq_ref[...] += jnp.sum(acc * acc, axis=0, keepdims=True)
+    y_ref[0] = acc.reshape(h, w, cout).astype(y_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def conv3x3_with_stats(x, w, interpret: Optional[bool] = None
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """``(y, col_sum, col_sumsq)`` for ``y = conv3x3_same(x, w)`` in one pass.
+
+    x: (N, H, W, Cin); w: (3, 3, Cin, Cout) HWIO. Stats accumulate in fp32
+    over all N*H*W positions per output channel (the exact reductions
+    train-mode BN needs).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, h, ww, cin = x.shape
+    assert w.shape[:3] == (3, 3, cin), (x.shape, w.shape)
+    cout = w.shape[-1]
+    wk = w.reshape(9, cin, cout)
+
+    vmem = ((h + 2) * (ww + 2) * cin * (x.dtype.itemsize + 1)  # x blk+scratch
+            + 9 * cin * cout * w.dtype.itemsize                # taps, resident
+            + h * ww * cout * (4 + x.dtype.itemsize)           # acc + y tile
+            + 2 * cout * 4)                                    # stat residents
+    if vmem > 12 * 2 ** 20:
+        raise ValueError(
+            f"per-step VMEM footprint ~{vmem >> 20} MB for 3x3 fusion on "
+            f"({n},{h},{ww},{cin})->{cout} exceeds the 12 MB budget; "
+            "use the unfused conv+BN path for this layer")
+
+    y, s, sq = pl.pallas_call(
+        functools.partial(_kernel, h=h, w=ww, cout=cout),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h, ww, cin), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((9, cin, cout), lambda i: (0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, ww, cout), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, ww, cout), x.dtype),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((h + 2, ww + 2, cin), x.dtype)],
+        interpret=interpret,
+    )(x, wk)
+    return y, s[0], sq[0]
+
+
+# --------------------------------------------------- fused train-mode BN op
+
+_DN = ("NHWC", "HWIO", "NHWC")
+
+
+def _conv3x3(x, w):
+    return lax.conv_general_dilated(x, w, (1, 1), ((1, 1), (1, 1)),
+                                    dimension_numbers=_DN)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def conv3x3_bn_train(x, w, gamma, beta, eps, interpret=None):
+    """conv3x3(SAME) + train-mode BN over (N, H, W); the forward runs the
+    one-pass Pallas kernel. Returns ``(out, mean, var)`` (stats fp32,
+    biased var — the ``ops.batch_norm.batch_norm_train`` contract)."""
+    out, mean, var, *_ = _forward(x, w, gamma, beta, eps, interpret)
+    return out, mean, var
+
+
+def _forward(x, w, gamma, beta, eps, interpret):
+    m = x.shape[0] * x.shape[1] * x.shape[2]
+    y, s, sq = conv3x3_with_stats(x, w, interpret=interpret)
+    mean = s / m
+    var = jnp.maximum(sq / m - mean * mean, 0.0)
+    inv = lax.rsqrt(var + eps)
+    xhat = (y.astype(jnp.float32) - mean) * inv
+    out = (xhat * gamma.astype(jnp.float32)
+           + beta.astype(jnp.float32)).astype(x.dtype)
+    return out, mean, var, y, inv
+
+
+def _fwd(x, w, gamma, beta, eps, interpret):
+    out, mean, var, y, inv = _forward(x, w, gamma, beta, eps, interpret)
+    return (out, mean, var), (x, w, gamma, y, mean, inv)
+
+
+def _bwd(eps, interpret, res, cts):
+    dout, _dmean, _dvar = cts  # stats feed running buffers: non-diff
+    x, w, gamma, y, mean, inv = res
+    m = x.shape[0] * x.shape[1] * x.shape[2]
+    dy = dout.astype(jnp.float32)
+    xhat = (y.astype(jnp.float32) - mean) * inv
+    dbeta = jnp.sum(dy, axis=(0, 1, 2))
+    dgamma = jnp.sum(dy * xhat, axis=(0, 1, 2))
+    g32 = gamma.astype(jnp.float32)
+    # closed-form BN input gradient (see ops/batch_norm.py)
+    dyconv = (g32 * inv / m) * (m * dy - dbeta - xhat * dgamma)
+    dyconv = dyconv.astype(x.dtype)
+    # conv input grad: correlate with the spatially-flipped, io-swapped taps
+    w_flip = w[::-1, ::-1].swapaxes(2, 3).astype(x.dtype)
+    dx = _conv3x3(dyconv, w_flip)
+    # conv weight grad: batch becomes the contraction — (Cin,H,W,N) conv
+    # (H,W,N,Cout) with SAME padding yields the (Cin,3,3,Cout) taps
+    dw = lax.conv_general_dilated(
+        x.transpose(3, 1, 2, 0), dyconv.transpose(1, 2, 0, 3),
+        (1, 1), ((1, 1), (1, 1)), dimension_numbers=_DN)
+    dw = dw.transpose(1, 2, 0, 3)
+    return (dx, dw.astype(w.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(gamma.dtype))
+
+
+conv3x3_bn_train.defvjp(_fwd, _bwd)

@@ -1,0 +1,152 @@
+"""FusedConv3x3BN / conv3x3_with_stats must be numerically interchangeable
+with the SpatialConvolution(3x3, pad 1) + SpatialBatchNormalization pair
+(interpret-mode Pallas on CPU; ``nn/fused.py``, ``ops/conv3x3_bn.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.fused import FusedConv3x3BN
+from bigdl_tpu.nn.module import functional_apply
+from bigdl_tpu.ops.conv3x3_bn import conv3x3_bn_train, conv3x3_with_stats
+
+
+def _rand(*shape, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(*shape).astype(np.float32))
+
+
+class TestKernel:
+    @pytest.mark.parametrize("n,h,w,cin,cout", [
+        (2, 8, 8, 4, 8), (1, 5, 7, 3, 2), (3, 4, 4, 8, 16)])
+    def test_matches_xla_conv_and_stats(self, n, h, w, cin, cout):
+        x = _rand(n, h, w, cin)
+        wt = _rand(3, 3, cin, cout, seed=1) * 0.3
+        y, s, sq = conv3x3_with_stats(x, wt, interpret=True)
+        ref = jax.lax.conv_general_dilated(
+            x, wt, (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(s),
+                                   np.asarray(ref.sum(axis=(0, 1, 2))),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(sq),
+                                   np.asarray((ref ** 2).sum(axis=(0, 1, 2))),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_grads_match_composition(self):
+        n, h, w, cin, cout = 2, 6, 6, 4, 8
+        x = _rand(n, h, w, cin)
+        wt = _rand(3, 3, cin, cout, seed=1) * 0.3
+        gamma = _rand(cout, seed=2) * 0.1 + 1.0
+        beta = _rand(cout, seed=3) * 0.1
+        eps = 1e-5
+
+        def ref_loss(x_, w_, g_, b_):
+            y = jax.lax.conv_general_dilated(
+                x_, w_, (1, 1), ((1, 1), (1, 1)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            mean = y.mean(axis=(0, 1, 2))
+            var = y.var(axis=(0, 1, 2))
+            xhat = (y - mean) * jax.lax.rsqrt(var + eps)
+            return jnp.sum((xhat * g_ + b_) ** 2)
+
+        def fused_loss(x_, w_, g_, b_):
+            out, _, _ = conv3x3_bn_train(x_, w_, g_, b_, eps, True)
+            return jnp.sum(out ** 2)
+
+        ref = jax.grad(ref_loss, argnums=(0, 1, 2, 3))(x, wt, gamma, beta)
+        got = jax.grad(fused_loss, argnums=(0, 1, 2, 3))(x, wt, gamma, beta)
+        for r, o, name in zip(ref, got, ["dx", "dw", "dgamma", "dbeta"]):
+            np.testing.assert_allclose(np.asarray(o), np.asarray(r),
+                                       rtol=2e-3, atol=2e-3, err_msg=name)
+
+
+class TestModule:
+    def _pair(self, cin, cout):
+        return (nn.Sequential()
+                .add(nn.SpatialConvolution(cin, cout, 3, 3, 1, 1, 1, 1,
+                                           with_bias=False))
+                .add(nn.SpatialBatchNormalization(cout)))
+
+    def _sync(self, fused, pair):
+        conv, bn = pair[0], pair[1]
+        fused.weight = jnp.asarray(conv.weight)
+        fused.gamma = jnp.asarray(bn.weight)
+        fused.beta = jnp.asarray(bn.bias)
+
+    def test_training_forward_grads_and_buffers_match_pair(self):
+        cin, cout = 4, 8
+        x = _rand(2, 8, 8, cin)
+        pair = self._pair(cin, cout)
+        fused = FusedConv3x3BN(cin, cout)
+        self._sync(fused, pair)
+
+        def loss(module, p):
+            out, buf = functional_apply(module, p, module.buffer_tree(), x,
+                                        training=True)
+            return jnp.sum(out ** 2), (out, buf)
+
+        (l1, (o1, b1)), g1 = jax.value_and_grad(
+            lambda p: loss(pair, p), has_aux=True)(pair.parameter_tree())
+        (l2, (o2, b2)), g2 = jax.value_and_grad(
+            lambda p: loss(fused, p), has_aux=True)(fused.parameter_tree())
+
+        np.testing.assert_allclose(np.asarray(o2), np.asarray(o1),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(float(l2), float(l1), rtol=1e-5)
+        conv_key, bn_key = sorted(g1.keys())
+        np.testing.assert_allclose(np.asarray(g2["weight"]),
+                                   np.asarray(g1[conv_key]["weight"]),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(g2["gamma"]),
+                                   np.asarray(g1[bn_key]["weight"]),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(g2["beta"]),
+                                   np.asarray(g1[bn_key]["bias"]),
+                                   rtol=2e-3, atol=2e-3)
+
+        def by_name(tree):
+            out = {}
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+                key = str(path[-1].key
+                          if hasattr(path[-1], "key") else path[-1])
+                out[key] = np.asarray(leaf)
+            return out
+
+        n1, n2 = by_name(b1), by_name(b2)
+        for name in ("running_mean", "running_var"):
+            np.testing.assert_allclose(n2[name], n1[name], rtol=1e-3,
+                                       atol=1e-3, err_msg=name)
+
+    def test_eval_matches_pair_eval(self):
+        cin, cout = 4, 8
+        pair = self._pair(cin, cout)
+        fused = FusedConv3x3BN(cin, cout)
+        self._sync(fused, pair)
+        x = _rand(2, 6, 6, cin)
+        # run a train step on both so running stats are non-trivial
+        pair.training_mode()
+        fused.training_mode()
+        pair.forward(x)
+        fused.forward(x)
+        pair.evaluate_mode()
+        fused.evaluate_mode()
+        np.testing.assert_allclose(np.asarray(fused.forward(x)),
+                                   np.asarray(pair.forward(x)),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_adopts_fused_3x3(monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_FUSED_3X3", "1")
+    from bigdl_tpu.models import resnet
+    model = resnet.build(10, depth=50)
+    reprs = repr(model)
+    assert "FusedConv3x3BN" in reprs
+    out = model.forward(jnp.zeros((1, 224, 224, 3)))
+    assert out.shape == (1, 10)
+    monkeypatch.delenv("BIGDL_TPU_FUSED_3X3")
+    assert "FusedConv3x3BN" not in repr(resnet.build(10, depth=50))
