@@ -120,21 +120,103 @@ def _timed_loop(step, state, budget_s, max_steps, batch):
     return batch * done / elapsed
 
 
-def worker_resnet50(batch, steps, budget_s, precision="bf16", platform=None):
-    jax = _init_jax(platform)
+# Fwd multiply-accumulate counts per record at the bench input shapes;
+# train FLOPs/record = 3 * 2 * MAC (backward ~ 2x forward).
+_FWD_MACS = {
+    "resnet50": 4.1e9,       # 224x224; He et al. table 1
+    "vgg16": 15.47e9,        # 224x224 convs+fcs
+    "inception_v1": 1.5e9,   # GoogLeNet paper: "1.5 billion multiply-adds"
+}
+
+# BASELINE workload registry (BASELINE.md configs 1-5 + the transformer):
+# build() -> (model, criterion, data_fn(rng, batch) -> (data, labels),
+#             records_per_batch_factor)
+_SEQ_LEN = {"lstm": 128, "transformer": 512}
+
+
+def _build_workload(name, batch):
     import jax.numpy as jnp
     import numpy as np
-
     from bigdl_tpu import nn
-    from bigdl_tpu.models import resnet
+
+    rng = np.random.default_rng(0)
+
+    def img(shape, classes):
+        data = jnp.asarray(rng.normal(0, 1, (batch,) + shape)
+                           .astype("float32"))
+        labels = jnp.asarray(rng.integers(1, classes + 1, (batch,))
+                             .astype("float32"))
+        return data, labels
+
+    if name == "resnet50":
+        from bigdl_tpu.models import resnet
+        return (resnet.build(class_num=1000, depth=50),
+                nn.ClassNLLCriterion(), *img((224, 224, 3), 1000), 1)
+    if name == "vgg16":
+        from bigdl_tpu.models import vgg
+        return (vgg.build_imagenet(class_num=1000, depth=16),
+                nn.ClassNLLCriterion(), *img((224, 224, 3), 1000), 1)
+    if name == "inception_v1":
+        from bigdl_tpu.models import inception
+        return (inception.build(class_num=1000),
+                nn.ClassNLLCriterion(), *img((224, 224, 3), 1000), 1)
+    if name == "lenet":
+        from bigdl_tpu.models import lenet
+        return (lenet.build(10), nn.ClassNLLCriterion(),
+                *img((28, 28, 1), 10), 1)
+    if name == "lstm":
+        from bigdl_tpu.models import rnn
+        t = _SEQ_LEN["lstm"]
+        model = rnn.build_classifier(10000, 128, 256, 20, cell="lstm")
+        data = jnp.asarray(rng.integers(1, 10001, (batch, t))
+                           .astype("float32"))
+        labels = jnp.asarray(rng.integers(1, 21, (batch,)).astype("float32"))
+        return model, nn.ClassNLLCriterion(), data, labels, 1
+    if name == "transformer":
+        from bigdl_tpu.models import transformer
+        t = _SEQ_LEN["transformer"]
+        # embed 256 / 4 heads -> head dim 64: the config the flash-attention
+        # dispatch gate admits (seq >= 256, d % 64 == 0)
+        model = transformer.build_lm(10000, embed_dim=256, num_heads=4,
+                                     ffn_dim=1024, num_layers=4, max_len=t)
+        data = jnp.asarray(rng.integers(1, 10001, (batch, t))
+                           .astype("float32"))
+        labels = jnp.asarray(rng.integers(1, 10001, (batch, t))
+                             .astype("float32"))
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
+        return model, crit, data, labels, t
+    raise ValueError(name)
+
+
+def _transformer_flops_per_token(model, seq_len, layers=4, embed=256):
+    """~6 FLOPs/param/token for the matmul params + the attention quadratic
+    (12*S*E per layer per token, fwd+bwd)."""
+    import numpy as np
+    n_params = 0
+    for leaf in _tree_leaves(model.parameter_tree()):
+        if getattr(leaf, "ndim", 0) >= 2 and leaf.shape[0] != 10000:
+            n_params += int(np.prod(leaf.shape))
+    return 6 * n_params + 12 * seq_len * embed * layers
+
+
+def _tree_leaves(tree):
+    import jax
+    return jax.tree_util.tree_leaves(tree)
+
+
+def worker_train(name, batch, steps, budget_s, precision="bf16",
+                 platform=None):
+    jax = _init_jax(platform)
+    import jax.numpy as jnp
+
     from bigdl_tpu.nn.module import functional_apply
     from bigdl_tpu.ops.precision import DtypePolicy, cast_tree
     from bigdl_tpu.optim.methods import SGD
     from bigdl_tpu.utils.rng import manual_seed
 
     manual_seed(42)
-    model = resnet.build(class_num=1000, depth=50)
-    criterion = nn.ClassNLLCriterion()
+    model, criterion, data, labels, rec_factor = _build_workload(name, batch)
     opt_method = SGD(learningrate=0.1, momentum=0.9)
     policy = DtypePolicy.bf16() if precision == "bf16" else DtypePolicy.fp32()
 
@@ -168,82 +250,66 @@ def worker_resnet50(batch, steps, budget_s, precision="bf16", platform=None):
                                  (params, buffers, opt_state))
 
     jstep = jax.jit(multi_step, donate_argnums=(0, 1, 2))
-    rng = np.random.default_rng(0)
-    data = jnp.asarray(rng.normal(0, 1, (batch, 224, 224, 3)).astype("float32"))
-    labels = jnp.asarray(rng.integers(1, 1001, (batch,)).astype("float32"))
 
     state = {
         "s": (params, buffers, opt_state),
-        "_force": lambda st: float(jnp.sum(st["s"][0]["0"]["weight"])),
+        "_force": lambda st: float(jnp.sum(_tree_leaves(st["s"][0])[0])),
     }
 
     def step(st):
         p, b, o = st["s"]
         return {"s": jstep(p, b, o, data, labels)}
 
-    return _timed_loop(step, state, budget_s, steps, batch * K)
-
-
-def worker_lenet(batch, steps, budget_s, platform=None):
-    jax = _init_jax(platform)
-    import jax.numpy as jnp
-    import numpy as np
-
-    from bigdl_tpu import nn
-    from bigdl_tpu.models import lenet
-    from bigdl_tpu.nn.module import functional_apply
-    from bigdl_tpu.optim.methods import SGD
-
-    model = lenet.build(10)
-    criterion = nn.ClassNLLCriterion()
-    opt_method = SGD(learningrate=0.1)
-    params, buffers = model.parameter_tree(), model.buffer_tree()
-    opt_state = opt_method.init_state(params)
-
-    def step_fn(params, opt_state, data, labels):
-        def loss_fn(p):
-            out, _ = functional_apply(model, p, buffers, data, training=True)
-            return criterion.apply(out, labels)
-
-        grads = jax.grad(loss_fn)(params)
-        return opt_method.update(grads, opt_state, params)
-
-    jstep = jax.jit(step_fn, donate_argnums=(0, 1))
-    rng = np.random.default_rng(0)
-    data = jnp.asarray(rng.normal(0, 1, (batch, 28, 28, 1)).astype("float32"))
-    labels = jnp.asarray(rng.integers(1, 11, (batch,)).astype("float32"))
-
-    state = {
-        "s": (params, opt_state),
-        "_force": lambda st: float(jnp.sum(st["s"][0]["1"]["weight"])),
-    }
-
-    def step(st):
-        p, o = st["s"]
-        return {"s": jstep(p, o, data, labels)}
-
-    return _timed_loop(step, state, budget_s, steps, batch)
+    rps = _timed_loop(step, state, budget_s, steps, batch * K)
+    return rps * rec_factor, model
 
 
 def run_worker(args):
     """Execute one attempt and print its result JSON (worker protocol:
     last stdout line is the JSON)."""
-    if args.worker == "resnet50":
-        ips = worker_resnet50(args.batch, args.steps, args.budget,
+    name = args.worker
+    rps, model = worker_train(name, args.batch, args.steps, args.budget,
                               precision=args.precision,
                               platform=args.platform or None)
-        mfu = ips * RESNET50_TRAIN_FLOPS_PER_IMAGE / V5E_BF16_FLOPS
+    if name in _FWD_MACS:
+        flops = 6 * _FWD_MACS[name]
+        mfu = rps * flops / V5E_BF16_FLOPS
         out = {
-            "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
-            "value": round(ips, 2),
+            "metric": f"{name}_imagenet_train_images_per_sec_per_chip",
+            "value": round(rps, 2),
             "unit": "images/sec/chip",
-            "vs_baseline": round(ips / NORTH_STAR_IMG_PER_SEC, 4),
+            "vs_baseline": round(mfu / 0.5, 4),  # vs the 50%-MFU north star
             "mfu": round(mfu, 4),
             "batch": args.batch,
         }
+        if name == "resnet50":
+            out["metric"] = "resnet50_imagenet_train_images_per_sec_per_chip"
+            out["vs_baseline"] = round(rps / NORTH_STAR_IMG_PER_SEC, 4)
+    elif name == "transformer":
+        t = _SEQ_LEN["transformer"]
+        flops = _transformer_flops_per_token(model, t)
+        mfu = rps * flops / V5E_BF16_FLOPS
+        out = {
+            "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+            "value": round(rps, 2),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": round(mfu / 0.5, 4),
+            "mfu": round(mfu, 4),
+            "batch": args.batch,
+            "seq_len": t,
+        }
+    elif name == "lstm":
+        out = {
+            "metric": "lstm_textclassifier_train_records_per_sec",
+            "value": round(rps, 2),
+            "unit": "records/sec/chip",
+            # only published reference throughput: SimpleRNN 4.8 rec/s
+            # (models/rnn/README.md:105-108)
+            "vs_baseline": round(rps / LENET_BASELINE_RPS, 2),
+            "batch": args.batch,
+            "seq_len": _SEQ_LEN["lstm"],
+        }
     else:
-        rps = worker_lenet(args.batch, args.steps, args.budget,
-                           platform=args.platform or None)
         out = {
             "metric": "lenet_mnist_train_records_per_sec",
             "value": round(rps, 2),
@@ -329,9 +395,73 @@ def _probe_backend(timeout_s=120, tries=2):
     return False
 
 
+_MODELS = ["resnet50", "vgg16", "inception_v1", "lenet", "lstm",
+           "transformer"]
+
+# Per-model TPU attempt ladders, largest-first: (batch, steps, budget_s).
+_LADDERS = {
+    "resnet50": [(256, 20, 540), (128, 20, 360), (32, 20, 300)],
+    "vgg16": [(128, 20, 540), (32, 10, 300)],
+    "inception_v1": [(256, 20, 540), (64, 10, 300)],
+    "lenet": [(512, 100, 180)],
+    "lstm": [(256, 20, 420), (64, 10, 300)],
+    "transformer": [(32, 20, 420), (8, 10, 300)],
+}
+_CPU_FALLBACK = {  # small shapes that finish on CPU in minutes
+    "resnet50": (32, 10, 300), "vgg16": (8, 5, 300),
+    "inception_v1": (16, 5, 300), "lenet": (512, 50, 180),
+    "lstm": (32, 5, 300), "transformer": (4, 5, 300),
+}
+
+
+def _model_attempts(model):
+    out = [(f"{model}-b{b}", model, b, s, bud, "")
+           for b, s, bud in _LADDERS[model]]
+    b, s, bud = _CPU_FALLBACK[model]
+    out.append((f"{model}-cpu", model, b, s, bud, "cpu"))
+    return out
+
+
+def run_all(args):
+    """One JSON line per BASELINE workload (PERF.md recording mode)."""
+    try:
+        total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET") or 7200)
+    except ValueError:
+        total_budget = 7200.0
+    tpu_ok = _probe_backend()
+    results = []
+    for model in (args.model.split(",") if args.model else _MODELS):
+        for name, worker, batch, steps, budget, platform in \
+                _model_attempts(model):
+            if platform != "cpu" and not tpu_ok:
+                continue
+            rem = total_budget - (time.monotonic() - _T_START)
+            if rem < 60:
+                log(f"--all: global budget exhausted before {name}")
+                break
+            res = _attempt(name, worker, args.batch or batch,
+                           args.steps or steps,
+                           min(args.budget or budget, rem - 30), platform,
+                           args.precision)
+            if res is not None:
+                res["model"] = model
+                print(json.dumps(res), flush=True)
+                results.append(res)
+                break
+    if not results:
+        print(json.dumps({"metric": "bench_failed", "value": 0.0,
+                          "unit": "", "vs_baseline": 0.0,
+                          "error": "no workload produced a number"}),
+              flush=True)
+        sys.exit(1)
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default=None, choices=["resnet50", "lenet"])
+    ap.add_argument("--model", default=None, choices=_MODELS)
+    ap.add_argument("--all", action="store_true",
+                    help="run every BASELINE workload; one JSON line each "
+                    "(headline driver mode stays single-line)")
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--precision", default="bf16", choices=["bf16", "fp32"])
@@ -339,31 +469,29 @@ def main():
                     help="per-attempt wall budget (seconds)")
     ap.add_argument("--platform", default="",
                     help="force a jax platform (worker only)")
-    ap.add_argument("--worker", default=None, choices=["resnet50", "lenet"],
+    ap.add_argument("--worker", default=None, choices=_MODELS,
                     help="internal: run one attempt in this process")
     args = ap.parse_args()
 
     if args.worker:
-        args.batch = args.batch or (128 if args.worker == "resnet50" else 512)
-        args.steps = args.steps or (20 if args.worker == "resnet50" else 100)
+        dflt_b, dflt_s, _ = _LADDERS[args.worker][0]
+        args.batch = args.batch or dflt_b
+        args.steps = args.steps or dflt_s
         args.budget = args.budget or 600
         run_worker(args)
         return
 
-    attempts = [
-        ("resnet50-b256", "resnet50", 256, 20, 540, ""),
-        ("resnet50-b128", "resnet50", 128, 20, 360, ""),
-        ("resnet50-b32", "resnet50", 32, 20, 300, ""),
-        ("lenet-b512", "lenet", 512, 100, 180, ""),
-        ("lenet-cpu", "lenet", 512, 50, 180, "cpu"),
-    ]
+    if args.all:
+        run_all(args)
+        return
+
     if args.model:
-        attempts = [a for a in attempts if a[1] == args.model]
-        if not any(a[5] == "cpu" for a in attempts):
-            # keep a last-resort CPU fallback for the REQUESTED model
-            w = args.model
-            attempts.append((f"{w}-cpu", w, 32 if w == "resnet50" else 512,
-                             10 if w == "resnet50" else 50, 300, "cpu"))
+        attempts = _model_attempts(args.model)
+    else:
+        # driver headline: resnet50 ladder, then lenet, then CPU fallback
+        attempts = ([a for a in _model_attempts("resnet50") if a[5] != "cpu"]
+                    + [("lenet-b512", "lenet", 512, 100, 180, ""),
+                       ("lenet-cpu", "lenet", 512, 50, 180, "cpu")])
     # user overrides apply to EVERY attempt (fallback chain preserved)
     if args.batch:
         attempts = [(f"{w}-b{args.batch}" + ("-cpu" if p else ""),
