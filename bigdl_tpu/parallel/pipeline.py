@@ -1,4 +1,5 @@
-"""Pipeline parallelism: GPipe microbatch schedule over the mesh ``pipe`` axis.
+"""Pipeline parallelism: GPipe + interleaved (circular) schedules over the
+mesh ``pipe`` axis.
 
 New capability — the reference has none (SURVEY §2.5: "Pipeline parallelism:
 ABSENT"). TPU-native design:
@@ -8,20 +9,31 @@ ABSENT"). TPU-native design:
   (leaves shaped (depth, ...)). Single-device forward is a ``lax.scan`` over
   the layer axis (this is also the memory-friendly way to run deep
   transformers on one chip — one compiled block body, not ``depth`` inlined
-  copies).
+  copies). Blocks MAY carry buffers (BatchNorm running stats): buffers are
+  stacked per layer and updated microbatch-sequentially, the same semantics
+  gradient-accumulation frameworks use.
 - Under pipeline parallelism the layer axis is simply SHARDED over the mesh
-  ``pipe`` axis (spec ``P('pipe', ...)``): each device owns
-  ``depth/P`` contiguous layers = one stage. ``gpipe_loss_fn`` runs the
-  GPipe schedule inside ``shard_map``: microbatches enter stage 0, march
-  stage-to-stage via ``lax.ppermute`` (neighbour ICI hops), and the bubble
-  costs (P-1)/(M+P-1) of the wall clock. ``jax.grad`` through the schedule
-  IS the backward pipeline — ppermute's transpose reverses the ring, so the
-  1F1B-style reverse traffic needs no extra code.
+  ``pipe`` axis (spec ``P('pipe', ...)``): each device owns ``depth/P``
+  stacked layers. ``gpipe_loss_fn`` runs the schedule inside ``shard_map``:
+  microbatches enter stage 0 and march stage-to-stage via ``lax.ppermute``
+  (neighbour ICI hops). ``jax.grad`` through the schedule IS the backward
+  pipeline — ppermute's transpose reverses the ring.
+- The schedule loop is a ``lax.scan`` over time steps (NOT a Python-unrolled
+  loop): trace/compile time is flat in the microbatch count, so deep
+  pipelines can run n_micro >> stages, where the GPipe bubble
+  (P-1)/(M+P-1) vanishes.
+- ``interleave=V`` selects the circular schedule: each device owns V
+  round-robin layer chunks (layer l lives on device l % P), a microbatch
+  rides the ring V times, and the bubble shrinks V-fold to
+  (P-1)/(V*M+P-1) at the cost of buffering up to M-P in-flight microbatch
+  activations on stage 0. Requires n_micro >= P. Use
+  ``circular_permutation`` to pre-permute the stacked layer axis so the
+  plain ``P('pipe')`` sharding hands each device its V chunks.
 
 The stacked layout means pipeline parallelism here is a *sharding choice*
-over the same arrays as single-chip execution — switching P requires no
-re-partitioning of the model definition, matching the framework's "one mesh,
-many layouts" design.
+over the same arrays as single-chip execution — switching P (or V) requires
+no re-partitioning of the model definition, matching the framework's "one
+mesh, many layouts" design.
 """
 
 from __future__ import annotations
@@ -31,6 +43,7 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from bigdl_tpu.nn.module import Module, functional_apply
@@ -41,25 +54,27 @@ class PipelineStack(Module):
     """``depth`` copies of ``block`` with parameters stacked on axis 0.
 
     ``block_factory()`` must build a block whose output shape equals its
-    input shape (transformer blocks, residual conv blocks) and which carries
-    no buffers (BatchNorm: use LayerNorm/GroupNorm instead — running stats
-    across pipeline stages are not well-defined under microbatching).
+    input shape (transformer blocks, residual conv blocks). Blocks may
+    carry buffers (BatchNorm running stats): buffer leaves are stacked per
+    layer like parameters and updated as each microbatch passes.
     """
 
     def __init__(self, block_factory: Callable[[], Module], depth: int):
         super().__init__()
         self.depth = depth
         self.block = block_factory()
-        assert not self.block.buffer_tree(), (
-            "PipelineStack blocks must be buffer-free (no BatchNorm)")
-        per_layer = []
+        per_layer, per_layer_buf = [], []
         for _ in range(depth):
-            per_layer.append(block_factory().parameter_tree())
-        stacked = jax.tree_util.tree_map(
+            b = block_factory()
+            per_layer.append(b.parameter_tree())
+            per_layer_buf.append(b.buffer_tree())
+        self._stacked = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *per_layer)
-        self._stacked = stacked  # dict tree; leaves (depth, ...)
+        self._stacked_buf = (jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *per_layer_buf)
+            if per_layer_buf[0] else {})
 
-    # The stacked tree IS this module's parameters.
+    # The stacked trees ARE this module's parameters/buffers.
     def parameter_tree(self) -> Dict[str, Any]:
         return self._stacked
 
@@ -67,24 +82,45 @@ class PipelineStack(Module):
         self._stacked = tree
 
     def buffer_tree(self) -> Dict[str, Any]:
-        return {}
+        return self._stacked_buf
 
     def load_buffer_tree(self, tree) -> None:
-        pass
+        self._stacked_buf = tree
 
-    def scan_apply(self, params, x, training: bool = False):
-        """Sequential (single-device) forward: scan over the layer axis."""
+    @property
+    def has_buffers(self) -> bool:
+        return bool(self._stacked_buf)
+
+    def scan_apply(self, params, x, training: bool = False, buffers=None):
+        """Sequential forward: scan over the layer axis. Returns ``out`` or
+        ``(out, new_buffers)`` when the stack carries buffers."""
         block = self.block
+        with_buf = buffers is not None and self.has_buffers
 
-        def body(h, layer_params):
-            out, _ = functional_apply(block, layer_params, {}, h,
-                                      training=training)
+        def body(h, xs):
+            if with_buf:
+                layer_params, layer_buf = xs
+                out, new_buf = functional_apply(block, layer_params,
+                                                layer_buf, h,
+                                                training=training)
+                return out, new_buf
+            out, _ = functional_apply(block, xs, {}, h, training=training)
             return out, None
 
-        out, _ = lax.scan(body, x, params)
+        xs = (params, buffers) if with_buf else params
+        out, ys = lax.scan(body, x, xs)
+        if with_buf:
+            return out, ys
         return out
 
     def update_output(self, input):
+        if self.has_buffers:
+            out, new_buf = self.scan_apply(self.parameter_tree(), input,
+                                           training=self.training,
+                                           buffers=self.buffer_tree())
+            if self.training:
+                self._stacked_buf = new_buf
+            return out
         return self.scan_apply(self.parameter_tree(), input,
                                training=self.training)
 
@@ -100,77 +136,226 @@ def pipeline_spec_tree(stack: PipelineStack, axis: str = PIPELINE_AXIS):
         stack.parameter_tree())
 
 
+def circular_permutation(depth: int, p: int, interleave: int) -> np.ndarray:
+    """Layer permutation for the circular schedule: the plain contiguous
+    ``P('pipe')`` shard of device ``d`` then contains its V round-robin
+    chunks in chunk order — chunk ``v`` of device ``d`` holds true layers
+    ``[(v*p + d)*c, (v*p + d + 1)*c)`` with ``c = depth / (p*V)``."""
+    assert depth % (p * interleave) == 0, (depth, p, interleave)
+    c = depth // (p * interleave)
+    return np.asarray([(v * p + d) * c + j
+                       for d in range(p)
+                       for v in range(interleave)
+                       for j in range(c)], dtype=np.int32)
+
+
+def schedule_length(n_micro: int, p: int, interleave: int = 1) -> int:
+    """Time steps of the schedule: bubble fraction = (P-1)/length."""
+    return n_micro * interleave + p - 1
+
+
 def gpipe_apply(stack: PipelineStack, local_params, x,
                 n_micro: int, axis_name: str = PIPELINE_AXIS,
-                training: bool = False, remat: bool = False):
+                training: bool = False, remat: bool = False,
+                local_buffers=None):
     """GPipe forward INSIDE shard_map.
 
     local_params: this stage's slice, leaves (depth/P, ...).
     x: full batch (replicated over the pipe axis); batch size must divide
-    by ``n_micro``. Returns the model output, replicated over the axis.
+    by ``n_micro``. Returns the model output (replicated over the axis), or
+    ``(output, new_local_buffers)`` when buffers are passed.
     ``remat=True`` recomputes each stage's internals in the backward
     (``jax.checkpoint``), bounding live activation memory at one microbatch
     boundary per schedule slot — the standard deep-pipeline recipe.
+
+    The time loop is a ``lax.scan``: one compiled step body regardless of
+    ``n_micro`` (compile time flat in microbatch count).
     """
     p = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b = x.shape[0]
     assert b % n_micro == 0, f"batch {b} must divide into {n_micro} microbatches"
     mbs = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+    with_buf = local_buffers is not None and stack.has_buffers
 
-    def stage_fn(h):
-        return stack.scan_apply(local_params, h, training=training)
+    def stage_fn(h, bufs):
+        if with_buf:
+            return stack.scan_apply(local_params, h, training=training,
+                                    buffers=bufs)
+        return stack.scan_apply(local_params, h, training=training), bufs
 
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
 
     perm = [(i, (i + 1) % p) for i in range(p)]
-    state = jnp.zeros_like(mbs[0])
-    state = lax.pcast(state, (axis_name,), to="varying")
-    out_buf = lax.pcast(jnp.zeros_like(mbs), (axis_name,), to="varying")
+    state0 = lax.pcast(jnp.zeros_like(mbs[0]), (axis_name,), to="varying")
+    out_buf0 = lax.pcast(jnp.zeros_like(mbs), (axis_name,), to="varying")
     is_first = (idx == 0)
     is_last = (idx == p - 1)
 
-    for t in range(n_micro + p - 1):
-        feed = mbs[min(t, n_micro - 1)]
+    def step(carry, t):
+        state, out_buf, bufs = carry
+        feed = lax.dynamic_index_in_dim(
+            mbs, jnp.minimum(t, n_micro - 1), 0, keepdims=False)
         inp = jnp.where(is_first & (t < n_micro), feed, state)
-        out = stage_fn(inp)
+        out, new_bufs = stage_fn(inp, bufs)
+        if with_buf:
+            # Idle (bubble) steps see garbage activations: a stage's
+            # buffers may only advance while it holds a real microbatch.
+            active = (t >= idx) & (t < idx + n_micro)
+            bufs = jax.tree_util.tree_map(
+                lambda nb, ob: jnp.where(active, nb, ob), new_bufs, bufs)
         w = t - (p - 1)
-        if w >= 0:
-            upd = lax.dynamic_update_index_in_dim(out_buf, out, w, 0)
-            out_buf = jnp.where(is_last, upd, out_buf)
+        upd = lax.dynamic_update_index_in_dim(out_buf, out,
+                                              jnp.maximum(w, 0), 0)
+        out_buf = jnp.where(is_last & (w >= 0), upd, out_buf)
         state = lax.ppermute(out, axis_name, perm)
+        return (state, out_buf, bufs), None
+
+    (_, out_buf, bufs), _ = lax.scan(
+        step, (state0, out_buf0, local_buffers),
+        jnp.arange(schedule_length(n_micro, p)))
 
     # Only the last stage holds real outputs; psum replicates them (its
     # transpose broadcasts the output cotangent back to the last stage).
+    out_buf = lax.psum(out_buf, axis_name)
+    out = out_buf.reshape(b, *out_buf.shape[2:])
+    if with_buf:
+        return out, bufs
+    return out
+
+
+def circular_apply(stack: PipelineStack, local_params, x, n_micro: int,
+                   interleave: int, axis_name: str = PIPELINE_AXIS,
+                   training: bool = False, remat: bool = False):
+    """Interleaved (circular) pipeline forward INSIDE shard_map.
+
+    Device ``d`` holds ``interleave`` (=V) round-robin layer chunks (the
+    ``circular_permutation`` layout); items ride the ring V times in a
+    chunk-major conveyor (all microbatches of chunk v, then chunk v+1),
+    so the steady-state bubble is ``(P-1)/(V*M+P-1)`` — V times smaller
+    than GPipe. Requires ``n_micro >= P`` (the wrap-around latency) and a
+    buffer of ``M-P+1`` in-flight activations. Buffered stacks are not
+    supported here (use the GPipe schedule for BatchNorm stacks).
+    """
+    assert not stack.has_buffers, \
+        "circular schedule supports buffer-free stacks only"
+    p = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    v = interleave
+    b = x.shape[0]
+    m = n_micro
+    assert b % m == 0, f"batch {b} must divide into {m} microbatches"
+    assert m >= p, f"circular schedule needs n_micro ({m}) >= stages ({p})"
+    mbs = x.reshape(m, b // m, *x.shape[1:])
+
+    local_depth = jax.tree_util.tree_leaves(local_params)[0].shape[0]
+    assert local_depth % v == 0, (local_depth, v)
+    lc = local_depth // v
+
+    def chunk_fn(vv, h):
+        chunk_params = jax.tree_util.tree_map(
+            lambda leaf: lax.dynamic_slice_in_dim(leaf, vv * lc, lc, 0),
+            local_params)
+        return stack.scan_apply(chunk_params, h, training=training)
+
+    if remat:
+        chunk_fn = jax.checkpoint(chunk_fn)
+
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    delay = m - p  # steps a wrapped activation waits before stage 0 reuses it
+    state0 = lax.pcast(jnp.zeros_like(mbs[0]), (axis_name,), to="varying")
+    fifo0 = lax.pcast(
+        jnp.zeros((delay + 1,) + mbs.shape[1:], mbs.dtype),
+        (axis_name,), to="varying")
+    out_buf0 = lax.pcast(jnp.zeros_like(mbs), (axis_name,), to="varying")
+    is_first = (idx == 0)
+    is_last = (idx == p - 1)
+
+    def step(carry, t):
+        state, fifo, out_buf = carry
+        # Item s = v*M + m_i on device d at time t = s + d.
+        s = jnp.clip(t - idx, 0, v * m - 1)
+        vv, mi = s // m, s % m
+        fresh = lax.dynamic_index_in_dim(mbs, mi, 0, keepdims=False)
+        # Stage 0's chunk-v>0 input: the wrap-around delivery of item
+        # s - M (written to the fifo at step s - M + P - 1) is consumed
+        # ``delay`` steps later — which is exactly when its slot comes up
+        # for rewrite, so read slot t BEFORE this step's write below.
+        recycled = lax.dynamic_index_in_dim(
+            fifo, t % (delay + 1), 0, keepdims=False)
+        inp = jnp.where(is_first, jnp.where(vv == 0, fresh, recycled), state)
+        out = chunk_fn(vv, inp)
+        # Last chunk done on last device: record microbatch output.
+        w = jnp.maximum(s - (v - 1) * m, 0)
+        upd = lax.dynamic_update_index_in_dim(out_buf, out, w, 0)
+        out_buf = jnp.where(is_last & (vv == v - 1) & (t - idx >= 0),
+                            upd, out_buf)
+        nxt = lax.ppermute(out, axis_name, perm)
+        fifo = lax.dynamic_update_index_in_dim(fifo, nxt,
+                                               t % (delay + 1), 0)
+        return (nxt, fifo, out_buf), None
+
+    (_, _, out_buf), _ = lax.scan(
+        step, (state0, fifo0, out_buf0),
+        jnp.arange(schedule_length(m, p, v)))
     out_buf = lax.psum(out_buf, axis_name)
     return out_buf.reshape(b, *out_buf.shape[2:])
 
 
 def gpipe_loss_fn(stack: PipelineStack, criterion, mesh,
                   n_micro: int, axis_name: str = PIPELINE_AXIS,
-                  head: Optional[Callable] = None, remat: bool = False):
-    """(stacked_params, head_params, x, labels) -> scalar loss, jittable.
+                  head: Optional[Callable] = None, remat: bool = False,
+                  interleave: int = 1):
+    """(stacked_params, head_params, x, labels) -> scalar loss, jittable;
+    with a buffered stack the signature gains a buffers argument and the
+    return becomes ``(loss, new_buffers)``.
 
     Wraps the schedule in shard_map over ``mesh``; ``head`` is an optional
     pure fn (head_params, features) -> logits applied after the stack
     (replicated — run it on every stage; it is tiny relative to the stack).
+    ``interleave=V > 1`` selects the circular schedule (pass parameters
+    pre-permuted with ``circular_permutation``).
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     p_specs = pipeline_spec_tree(stack, axis_name)
 
+    if stack.has_buffers:
+        assert interleave == 1, \
+            "circular schedule supports buffer-free stacks only"
+        b_specs = jax.tree_util.tree_map(
+            lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))),
+            stack.buffer_tree())
+
+        def local_fn_buf(stacked, bufs, head_params, x, labels):
+            feats, new_bufs = gpipe_apply(stack, stacked, x, n_micro,
+                                          axis_name, training=True,
+                                          remat=remat, local_buffers=bufs)
+            logits = head(head_params, feats) if head is not None else feats
+            loss = criterion.apply(logits, labels).astype(jnp.float32)
+            return loss, new_bufs
+
+        return shard_map(
+            local_fn_buf, mesh=mesh,
+            in_specs=(p_specs, b_specs, P(), P(), P()),
+            out_specs=(P(), b_specs),
+            check_vma=False)
+
     def local_fn(stacked, head_params, x, labels):
-        feats = gpipe_apply(stack, stacked, x, n_micro, axis_name,
-                            training=True, remat=remat)
+        if interleave > 1:
+            feats = circular_apply(stack, stacked, x, n_micro, interleave,
+                                   axis_name, training=True, remat=remat)
+        else:
+            feats = gpipe_apply(stack, stacked, x, n_micro, axis_name,
+                                training=True, remat=remat)
         logits = head(head_params, feats) if head is not None else feats
         loss = criterion.apply(logits, labels).astype(jnp.float32)
         return loss
 
-    fn = shard_map(
+    return shard_map(
         local_fn, mesh=mesh,
         in_specs=(p_specs, P(), P(), P()),
         out_specs=P(),
         check_vma=False)
-    return fn
