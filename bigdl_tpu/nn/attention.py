@@ -239,15 +239,26 @@ class TransformerEncoderLayer(Module):
         return self.drop.forward(x)
 
     def update_output(self, input):
-        x = input
+        # Megatron sequence-parallel regions: when tagged by
+        # parallel.tensor_parallel.enable_sequence_parallel, the residual
+        # stream (norm/dropout/residual segments between the column->row
+        # matmul sandwiches) is constrained seq-sharded over the tensor
+        # axis; GSPMD lowers the boundaries as reduce-scatter/all-gather.
+        sp = getattr(self, "_sp", None)
+        if sp is not None:
+            from bigdl_tpu.parallel.tensor_parallel import sp_constrain
+            _c = lambda x: sp_constrain(x, sp)
+        else:
+            _c = lambda x: x
+        x = _c(input)
         if self.pre_norm:
-            x = x + self._drop(self.self_attn.forward(self.norm1.forward(x)))
+            x = _c(x + self._drop(self.self_attn.forward(self.norm1.forward(x))))
             h = self.linear2.forward(self._act(self.linear1.forward(
                 self.norm2.forward(x))))
-            return x + self._drop(h)
-        x = self.norm1.forward(x + self._drop(self.self_attn.forward(x)))
+            return _c(x + self._drop(h))
+        x = _c(self.norm1.forward(x + self._drop(self.self_attn.forward(x))))
         h = self.linear2.forward(self._act(self.linear1.forward(x)))
-        return self.norm2.forward(x + self._drop(h))
+        return _c(self.norm2.forward(x + self._drop(h)))
 
 
 class TransformerEncoder(Module):

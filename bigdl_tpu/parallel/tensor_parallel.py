@@ -21,6 +21,17 @@ The rules encode the Megatron pattern:
 
 Usage: automatic for known layer types via ``infer_param_specs(model)``;
 override per-module with ``module.tp_mode = "column" | "row" | "replicate"``.
+
+**Sequence-parallel regions** (Megatron-SP, Korthikanti et al.): between a
+row-parallel output and the next column-parallel input sit norm / dropout /
+residual segments whose activations would otherwise be fully replicated
+across the tensor group. ``enable_sequence_parallel(model, mesh)`` tags
+every transformer block so its residual stream carries a
+``with_sharding_constraint`` sharding the SEQUENCE dim over the tensor
+axis — GSPMD then lowers the boundary collectives as reduce-scatter (into
+the region) + all-gather (back out), the same total bytes as the Megatron
+all-reduce but with region activations and elementwise FLOPs divided by
+the axis size (contract-tested in tests/test_tensor_parallel.py).
 """
 
 from __future__ import annotations
@@ -33,6 +44,38 @@ from jax.sharding import PartitionSpec as P
 from bigdl_tpu.parallel.mesh import TENSOR_AXIS
 
 COLUMN, ROW, REPLICATE = "column", "row", "replicate"
+
+
+def enable_sequence_parallel(model, mesh, axis: str = TENSOR_AXIS,
+                             seq_dim: int = 1) -> int:
+    """Tag every ``TransformerEncoderLayer`` under ``model`` to constrain
+    its residual stream seq-sharded over ``axis``. Returns the number of
+    blocks tagged. Requires seq_len % mesh.shape[axis] == 0 at call sites
+    (GSPMD would otherwise pad unevenly)."""
+    from bigdl_tpu import nn
+    count = 0
+    stack = [model]
+    while stack:
+        m = stack.pop()
+        if isinstance(m, nn.TransformerEncoderLayer):
+            m._sp = (mesh, axis, seq_dim)
+            count += 1
+        stack.extend(m._modules.values())
+    return count
+
+
+def sp_constrain(x, sp):
+    """Apply the sequence-parallel sharding constraint (no-op when
+    ``sp`` is None)."""
+    if sp is None:
+        return x
+    import jax
+    from jax.sharding import NamedSharding
+    mesh, axis, seq_dim = sp
+    spec = [None] * x.ndim
+    spec[seq_dim] = axis
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec)))
 
 
 def _linear_specs(mode: Optional[str], axis: str) -> Dict[str, P]:
@@ -68,13 +111,49 @@ def _module_specs(module, axis: str) -> Dict[str, P]:
 
 
 def _tag_children(module) -> None:
-    """Auto-tag the Megatron column→row pairs inside known blocks."""
+    """Auto-tag Megatron column→row pairs inside known blocks:
+
+    - ``TransformerEncoderLayer``: FFN up = column, down = row;
+    - plain MLP stacks (``Sequential``): consecutive Linear pairs separated
+      only by parameter-free elementwise modules get column→row;
+    - ``TimeDistributed(Linear)`` heads (the causal-LM vocab projection):
+      column-parallel — the (T, V/P) logits stay sharded into LogSoftMax,
+      whose vocab reduction GSPMD turns into a small all-reduce while the
+      big logits tensor never materializes replicated.
+    """
     from bigdl_tpu import nn
     if isinstance(module, nn.TransformerEncoderLayer):
         if not hasattr(module.linear1, "tp_mode"):
             module.linear1.tp_mode = COLUMN
         if not hasattr(module.linear2, "tp_mode"):
             module.linear2.tp_mode = ROW
+        return
+    if isinstance(module, nn.TimeDistributed):
+        inner = getattr(module, "inner", None) or \
+            next(iter(module._modules.values()), None)
+        if isinstance(inner, nn.Linear) and not hasattr(inner, "tp_mode"):
+            inner.tp_mode = COLUMN
+        return
+    if isinstance(module, nn.Sequential):
+        children = list(module._modules.values())
+        i = 0
+        while i < len(children):
+            c = children[i]
+            if isinstance(c, nn.Linear) and not hasattr(c, "tp_mode"):
+                # scan past parameter-free elementwise modules for the
+                # row partner; tag only when the pair completes
+                j = i + 1
+                while (j < len(children)
+                       and not children[j]._parameters
+                       and not children[j]._modules):
+                    j += 1
+                if (j < len(children)
+                        and isinstance(children[j], nn.Linear)
+                        and not hasattr(children[j], "tp_mode")):
+                    c.tp_mode = COLUMN
+                    children[j].tp_mode = ROW
+                    i = j
+            i += 1
 
 
 def infer_param_specs(model, axis: str = TENSOR_AXIS,
