@@ -71,12 +71,20 @@ def _conv_bn(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
     (reference ``Inception_v2.scala`` Inception_Layer_v2). 1x1 pairs
     collapse into the Pallas-fused module under ``BIGDL_TPU_FUSED_1X1=1``
     (same opt-in as the ResNet builder; see PERF.md)."""
-    from bigdl_tpu.nn.fused import FusedConv1x1BN, use_fused_1x1
+    from bigdl_tpu.nn.fused import (FusedConv1x1BN, FusedConv3x3BN,
+                                    use_fused_1x1, use_fused_3x3)
     if (kw, kh, pw, ph) == (1, 1, 0, 0) and sw == sh and use_fused_1x1():
         # with_bias: the unfused pair's conv carries a bias (reference
         # default) — keep the parameter schema identical across the flag
         return (nn.Sequential()
                 .add(FusedConv1x1BN(n_in, n_out, sw, eps=1e-3,
+                                    init_method="xavier",
+                                    with_bias=True).set_name(name))
+                .add(nn.ReLU(True)))
+    if ((kw, kh, pw, ph, sw, sh) == (3, 3, 1, 1, 1, 1)
+            and use_fused_3x3()):
+        return (nn.Sequential()
+                .add(FusedConv3x3BN(n_in, n_out, eps=1e-3,
                                     init_method="xavier",
                                     with_bias=True).set_name(name))
                 .add(nn.ReLU(True)))

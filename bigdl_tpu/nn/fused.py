@@ -135,15 +135,23 @@ class FusedConv3x3BN(TensorModule):
 
     def __init__(self, n_input_plane: int, n_output_plane: int,
                  eps: float = 1e-5, momentum: float = 0.1,
-                 init_method: str = "kaiming"):
+                 init_method: str = "kaiming", with_bias: bool = False):
         super().__init__()
         self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
         self.eps, self.momentum = eps, momentum
+        self.with_bias = with_bias
         fan_in = 9 * n_input_plane
         self.register_parameter(
             "weight", init.conv_weight(init_method,
                                        (3, 3, n_input_plane, n_output_plane),
                                        fan_in, 9 * n_output_plane))
+        if with_bias:
+            # schema parity with conv(+bias)+BN pairs: a pre-BN bias only
+            # SHIFTS the batch mean (the train output is bias-invariant),
+            # so it folds into the running-stats/eval paths at vector cost
+            self.register_parameter("bias",
+                                    init.default_init((n_output_plane,),
+                                                      fan_in))
         self.register_parameter("gamma", init.ones((n_output_plane,)))
         self.register_parameter("beta", init.zeros((n_output_plane,)))
         self.register_buffer("running_mean", init.zeros((n_output_plane,)))
@@ -155,6 +163,9 @@ class FusedConv3x3BN(TensorModule):
             from bigdl_tpu.ops.conv3x3_bn import conv3x3_bn_train
             out, mean, var = conv3x3_bn_train(input, self.weight, self.gamma,
                                               self.beta, self.eps)
+            if self.with_bias:
+                mean = mean + jax.lax.stop_gradient(
+                    self.bias.astype(jnp.float32))
             n, h, w, _ = input.shape
             blend_running_stats(self, mean, var, n * h * w, self.momentum)
             return out
@@ -165,6 +176,8 @@ class FusedConv3x3BN(TensorModule):
         w_folded = (self.weight.astype(jnp.float32) * scale).astype(
             input.dtype)
         shift = self.beta - self.running_mean * scale
+        if self.with_bias:
+            shift = shift + self.bias.astype(jnp.float32) * scale
         return _conv3x3(input, w_folded) + shift.astype(input.dtype)
 
     def __repr__(self):

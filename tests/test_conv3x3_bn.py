@@ -154,3 +154,43 @@ def test_resnet_adopts_fused_3x3(monkeypatch):
     assert out.shape == (1, 10)
     monkeypatch.delenv("BIGDL_TPU_FUSED_3X3")
     assert "FusedConv3x3BN" not in repr(resnet.build(10, depth=50))
+
+
+def test_with_bias_matches_biased_pair():
+    # conv(+bias)+BN: the pre-BN bias shifts only the batch mean; train
+    # output, running stats, and eval output must match the unfused pair
+    cin, cout = 4, 8
+    pair = (nn.Sequential()
+            .add(nn.SpatialConvolution(cin, cout, 3, 3, 1, 1, 1, 1,
+                                       with_bias=True))
+            .add(nn.SpatialBatchNormalization(cout)))
+    fused = FusedConv3x3BN(cin, cout, with_bias=True)
+    conv, bn = pair[0], pair[1]
+    fused.weight = jnp.asarray(conv.weight)
+    fused.bias = jnp.asarray(conv.bias) + 0.5  # nonzero bias
+    conv.bias = jnp.asarray(fused.bias)
+    fused.gamma = jnp.asarray(bn.weight)
+    fused.beta = jnp.asarray(bn.bias)
+    x = _rand(2, 6, 6, cin, seed=11)
+    pair.training_mode()
+    fused.training_mode()
+    np.testing.assert_allclose(np.asarray(fused.forward(x)),
+                               np.asarray(pair.forward(x)),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(fused.running_mean),
+                               np.asarray(pair[1].running_mean),
+                               rtol=1e-4, atol=1e-4)
+    pair.evaluate_mode()
+    fused.evaluate_mode()
+    np.testing.assert_allclose(np.asarray(fused.forward(x)),
+                               np.asarray(pair.forward(x)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_vgg_and_inception_adopt_fused_3x3(monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_FUSED_3X3", "1")
+    from bigdl_tpu.models import inception, vgg
+    assert "FusedConv3x3BN" in repr(vgg.build(10))
+    assert "FusedConv3x3BN" in repr(inception.build_v2(10))
+    out = vgg.build(10).forward(jnp.zeros((1, 32, 32, 3)))
+    assert out.shape == (1, 10)
