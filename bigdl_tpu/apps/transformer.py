@@ -50,6 +50,10 @@ def train(argv) -> None:
     parser.add_argument("--contextParallel", default=None,
                         choices=[None, "ring", "ulysses"],
                         help="shard the sequence axis over the mesh")
+    parser.add_argument("--ringLayout", default="contiguous",
+                        choices=["contiguous", "zigzag"],
+                        help="ring shard layout; zigzag balances causal "
+                        "work across devices (ring mode only)")
     args = parser.parse_args(argv)
 
     samples = _synthetic_corpus(max(args.synthetic_size, args.batchSize),
@@ -61,7 +65,9 @@ def train(argv) -> None:
         args.vocab, args.embedDim, args.numHeads, ffn_dim=4 * args.embedDim,
         num_layers=args.numLayers, max_len=max(1024, args.seqLen),
         seq_axis="seq" if args.contextParallel else None,
-        seq_mode=args.contextParallel or "ring")
+        seq_mode=args.contextParallel or "ring",
+        seq_layout=args.ringLayout if args.contextParallel == "ring"
+        else "contiguous")
     criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
 
     if args.contextParallel:
@@ -109,6 +115,12 @@ def _train_context_parallel(model, criterion, ds, args):
             f"--seqLen {args.seqLen} is not divisible by the device count "
             f"{n}: sequence parallelism shards the sequence axis evenly "
             "across devices; pick a multiple")
+    zigzag = (args.contextParallel == "ring"
+              and args.ringLayout == "zigzag")
+    if zigzag and args.seqLen % (2 * n) != 0:
+        raise SystemExit(
+            f"--ringLayout zigzag needs --seqLen divisible by 2x the "
+            f"device count ({2 * n})")
     mesh = MeshTopology(sequence=n).build()
     method = SGD(learningrate=args.learningRate,
                  learningrate_decay=args.learningRateDecay,
@@ -130,8 +142,19 @@ def _train_context_parallel(model, criterion, ds, args):
         in_specs=(P(), P(None, "seq", None), P(None, "seq")),
         out_specs=P(), check_vma=False)
 
+    if zigzag:
+        # Zigzag ring layout: permute the EMBEDDED sequence (positions are
+        # already stamped globally) and the targets so the contiguous
+        # shard_map split hands device i its (i, 2P-1-i) chunk pair; the
+        # mean loss is permutation-invariant, so nothing is un-permuted.
+        from bigdl_tpu.parallel.context import zigzag_permutation
+        zperm = jnp.asarray(zigzag_permutation(args.seqLen, n))
+
     def loss_fn(p, tokens, targets):
         x, _ = functional_apply(embed, p["embed"], {}, tokens, training=True)
+        if zigzag:
+            x = jnp.take(x, zperm, axis=1)
+            targets = jnp.take(targets, zperm, axis=1)
         return sharded_tail(p["tail"], x, targets)
 
     @jax.jit

@@ -19,17 +19,23 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
              ffn_dim: int = 256, num_layers: int = 2,
              max_len: int = 1024, dropout: float = 0.0,
              seq_axis: Optional[str] = None,
-             seq_mode: str = "ring") -> nn.Sequential:
+             seq_mode: str = "ring",
+             seq_layout: str = "contiguous") -> nn.Sequential:
     """Causal LM: 1-based token ids (N, T) -> log-probs (N, T, vocab).
 
     ``seq_axis="seq"`` shards every attention layer over the mesh sequence
     axis (ring attention or Ulysses per ``seq_mode``) — long-context
-    training is a constructor argument, not a different model."""
+    training is a constructor argument, not a different model.
+    ``seq_layout="zigzag"`` selects the balanced causal ring layout; the
+    training loop must then permute the embedded sequence (and targets)
+    with ``parallel.context.zigzag_permutation`` before sharding — see
+    ``apps/transformer.py --ringLayout zigzag``."""
     return (nn.Sequential()
             .add(nn.LookupTable(vocab_size, embed_dim))
             .add(nn.PositionalEncoding(embed_dim, max_len, dropout))
             .add(nn.TransformerEncoder(num_layers, embed_dim, num_heads,
                                        ffn_dim, dropout=dropout, causal=True,
-                                       seq_axis=seq_axis, seq_mode=seq_mode))
+                                       seq_axis=seq_axis, seq_mode=seq_mode,
+                                       seq_layout=seq_layout))
             .add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size)))
             .add(nn.LogSoftMax()))

@@ -81,15 +81,19 @@ class MultiHeadAttention(Module):
     def __init__(self, embed_dim: int, num_heads: int,
                  dropout: float = 0.0, with_bias: bool = True,
                  causal: bool = False, block_size: int = 0,
-                 seq_axis: Optional[str] = None, seq_mode: str = "ring"):
+                 seq_axis: Optional[str] = None, seq_mode: str = "ring",
+                 seq_layout: str = "contiguous"):
         super().__init__()
         assert embed_dim % num_heads == 0, "embed_dim must divide num_heads"
         # seq_axis: mesh axis name for context parallelism. When set, the
         # module must run inside shard_map with activations sharded
         # (B, S/P, E) on that axis; attention goes through
-        # parallel/context.py (ring or ulysses).
+        # parallel/context.py (ring or ulysses). seq_layout="zigzag" is the
+        # balanced causal striping — the CALLER permutes the global
+        # sequence with context.zigzag_permutation before sharding.
         self.seq_axis = seq_axis
         self.seq_mode = seq_mode
+        self.seq_layout = seq_layout
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
@@ -167,9 +171,13 @@ class MultiHeadAttention(Module):
             from bigdl_tpu.parallel import context
             assert mask is None, (
                 "context-parallel attention supports causal masking only")
-            impl = (context.ring_attention if self.seq_mode == "ring"
-                    else context.ulysses_attention)
-            return impl(q, k, v, axis_name=self.seq_axis, causal=self.causal)
+            if self.seq_mode == "ring":
+                return context.ring_attention(
+                    q, k, v, axis_name=self.seq_axis, causal=self.causal,
+                    layout=self.seq_layout)
+            return context.ulysses_attention(q, k, v,
+                                             axis_name=self.seq_axis,
+                                             causal=self.causal)
         if flash_attention.use_flash(q, mask):
             return flash_attention.flash_attention(q, k, v, causal=self.causal)
         if self.block_size:
@@ -211,7 +219,7 @@ class TransformerEncoderLayer(Module):
                  dropout: float = 0.0, activation: str = "gelu",
                  pre_norm: bool = True, causal: bool = False,
                  block_size: int = 0, seq_axis: Optional[str] = None,
-                 seq_mode: str = "ring"):
+                 seq_mode: str = "ring", seq_layout: str = "contiguous"):
         super().__init__()
         from bigdl_tpu.nn.linear import Linear
         from bigdl_tpu.nn.regularization import Dropout
@@ -222,7 +230,8 @@ class TransformerEncoderLayer(Module):
                                             dropout=dropout, causal=causal,
                                             block_size=block_size,
                                             seq_axis=seq_axis,
-                                            seq_mode=seq_mode)
+                                            seq_mode=seq_mode,
+                                            seq_layout=seq_layout)
         self.linear1 = Linear(embed_dim, ffn_dim)
         self.linear2 = Linear(ffn_dim, embed_dim)
         self.norm1 = LayerNorm(embed_dim)
@@ -268,14 +277,15 @@ class TransformerEncoder(Module):
                  ffn_dim: int, dropout: float = 0.0, activation: str = "gelu",
                  pre_norm: bool = True, causal: bool = False,
                  block_size: int = 0, seq_axis: Optional[str] = None,
-                 seq_mode: str = "ring"):
+                 seq_mode: str = "ring", seq_layout: str = "contiguous"):
         super().__init__()
         self.num_layers = num_layers
         for i in range(num_layers):
             self.add_module(f"layer{i}", TransformerEncoderLayer(
                 embed_dim, num_heads, ffn_dim, dropout=dropout,
                 activation=activation, pre_norm=pre_norm, causal=causal,
-                block_size=block_size, seq_axis=seq_axis, seq_mode=seq_mode))
+                block_size=block_size, seq_axis=seq_axis, seq_mode=seq_mode,
+                seq_layout=seq_layout))
         self.final_norm = LayerNorm(embed_dim) if pre_norm else None
         if self.final_norm is not None:
             self.add_module("final_norm", self.final_norm)

@@ -174,6 +174,11 @@ class TestPerfHarness:
             transformer.train(["-b", "8", "--seqLen", "32", "-e", "1",
                                "--synthetic-size", "16", "--numHeads", heads,
                                "--contextParallel", mode])
+        # balanced causal ring layout end-to-end (seqLen % 2P == 0)
+        transformer.train(["-b", "8", "--seqLen", "32", "-e", "1",
+                           "--synthetic-size", "16", "--numHeads", "4",
+                           "--contextParallel", "ring",
+                           "--ringLayout", "zigzag"])
 
     def test_context_parallel_matches_sequential_loss(self):
         # PE offsets + pmean correctness: first-step loss of the seq-parallel
