@@ -23,10 +23,12 @@ from bigdl_tpu.nn.module import TensorModule
 def use_fused_1x1() -> bool:
     """The builders' shared opt-in gate (``BIGDL_TPU_FUSED_1X1=1``).
 
-    Single-chip only: ``pallas_call`` has no GSPMD partitioning rule, so
-    inside DistriOptimizer's sharded jitted step XLA would force
-    replication/all-gather of the activations. Warns once when enabled
-    with more than one visible device."""
+    Primarily a single-chip optimisation: ``pallas_call`` has no GSPMD
+    partitioning rule, so inside a sharded jitted step XLA may force
+    replication/all-gather of the activations (functionally verified
+    under both DistriOptimizer sync modes on the virtual mesh —
+    tests/test_fused_conv_bn.py — but measure before enabling it on a
+    multi-chip run)."""
     import os
     on = os.environ.get("BIGDL_TPU_FUSED_1X1", "").strip().lower() \
         in ("1", "true", "yes")
@@ -37,9 +39,9 @@ def use_fused_1x1() -> bool:
         use_fused_1x1._warned = True
         import logging
         logging.getLogger("bigdl_tpu.nn").info(
-            "BIGDL_TPU_FUSED_1X1 is a single-chip optimisation: the Pallas "
-            "kernel has no SPMD partitioning rule and forces activation "
-            "replication if used inside a sharded (multi-device) step")
+            "BIGDL_TPU_FUSED_1X1 is primarily a single-chip optimisation: "
+            "the Pallas kernel has no SPMD partitioning rule, so a sharded "
+            "(multi-device) step may replicate activations around it")
     return on
 
 

@@ -329,22 +329,36 @@ _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 # ------------------------------------------------------------- public entry
 
+def _env_block(name: str, default: int) -> int:
+    """On-chip block-size tuning without code edits
+    (``BIGDL_TPU_FLASH_BLOCK_Q`` / ``BIGDL_TPU_FLASH_BLOCK_K``)."""
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 256,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Flash attention, shapes (B, S, N, D); differentiable (Pallas fwd+bwd)."""
     if scale is None:
         scale = 1.0 / float(q.shape[-1]) ** 0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None:
+        block_q = _env_block("BIGDL_TPU_FLASH_BLOCK_Q", 256)
+    if block_k is None:
+        block_k = _env_block("BIGDL_TPU_FLASH_BLOCK_K", 256)
     o, _ = _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret)
     return o
 
 
 def flash_attention_with_lse(
         q, k, v, causal: bool = False, scale: Optional[float] = None,
-        block_q: int = 256, block_k: int = 256,
+        block_q: Optional[int] = None, block_k: Optional[int] = None,
         interpret: Optional[bool] = None) -> Tuple[jax.Array, jax.Array]:
     """Flash attention returning ``(o (B,S,N,D), lse (B,N,S) f32)``.
 
@@ -357,6 +371,10 @@ def flash_attention_with_lse(
         scale = 1.0 / float(q.shape[-1]) ** 0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None:
+        block_q = _env_block("BIGDL_TPU_FLASH_BLOCK_Q", 256)
+    if block_k is None:
+        block_k = _env_block("BIGDL_TPU_FLASH_BLOCK_K", 256)
     return _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
