@@ -60,12 +60,9 @@ def _shortcut(n_in, n_out, stride, shortcut_type="B"):
 
 
 def _basic_block(n_in, n_out, stride, shortcut_type="B"):
-    main = (nn.Sequential()
-            .add(_conv(n_in, n_out, 3, stride, 1))
-            .add(nn.SpatialBatchNormalization(n_out))
-            .add(nn.ReLU())
-            .add(_conv(n_out, n_out, 3, 1, 1))
-            .add(nn.SpatialBatchNormalization(n_out)))
+    main = _add_conv_bn(nn.Sequential(), n_in, n_out, 3, stride, 1)
+    main.add(nn.ReLU())
+    _add_conv_bn(main, n_out, n_out, 3, 1, 1)
     return (nn.Sequential()
             .add(nn.ConcatTable().add(main).add(_shortcut(n_in, n_out, stride,
                                                           shortcut_type)))
@@ -122,10 +119,8 @@ def build_cifar(class_num: int = 10, depth: int = 20,
     Input (N, 32, 32, 3)."""
     assert (depth - 2) % 6 == 0, "CIFAR ResNet depth must be 6n+2"
     n = (depth - 2) // 6
-    model = (nn.Sequential()
-             .add(_conv(3, 16, 3, 1, 1))
-             .add(nn.SpatialBatchNormalization(16))
-             .add(nn.ReLU()))
+    model = _add_conv_bn(nn.Sequential(), 3, 16, 3, 1, 1)
+    model.add(nn.ReLU())
     n_in = 16
     for stage, w in enumerate([16, 32, 64]):
         for i in range(n):
