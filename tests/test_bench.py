@@ -25,7 +25,7 @@ def run_main(monkeypatch, capsys, argv, attempts_log, probe=True,
     results = results or {}
 
     def fake_attempt(name, worker, batch, steps, budget, platform="",
-                     precision="bf16", grace=90):
+                     precision="bf16", grace=90, extra_env=None):
         attempts_log.append((name, worker, batch, budget, platform))
         return results.get(name)
 
@@ -51,7 +51,9 @@ def test_first_success_wins(monkeypatch, capsys):
                              "unit": "u", "vs_baseline": 0.63}}
     parsed, code = run_main(monkeypatch, capsys, [], log, results=res)
     assert code == 0 and parsed["value"] == 2526.0
-    assert [a[0] for a in log] == ["resnet50-b256"]
+    # the plain win triggers exactly one fused A/B attempt (here failing ->
+    # plain number kept), then stops
+    assert [a[0] for a in log] == ["resnet50-b256", "resnet50-b256-fused"]
 
 
 def test_all_fail_emits_diagnostic_json(monkeypatch, capsys):
@@ -116,3 +118,40 @@ def test_exhausted_budget_skips_straight_to_cpu(monkeypatch, capsys):
     parsed = json.loads(out[-1])
     assert all(a[4] == "cpu" for a in log), log
     assert parsed["value"] == 1.0
+
+
+def test_fused_ab_picks_better_number(monkeypatch, capsys):
+    # after a plain resnet50 TPU win, the fused ladder runs once and the
+    # BETTER value becomes the headline, with the comparison recorded
+    log = []
+    res = {"resnet50-b256": {"metric": "m", "value": 2526.0,
+                             "unit": "u", "vs_baseline": 0.6},
+           "resnet50-b256-fused": {"metric": "m", "value": 3100.0,
+                                   "unit": "u", "vs_baseline": 0.77}}
+    parsed, code = run_main(monkeypatch, capsys, [], log, results=res)
+    assert code == 0
+    assert parsed["value"] == 3100.0
+    assert parsed["fused_kernels"] is True
+    assert parsed["unfused_value"] == 2526.0
+    assert any(n == "resnet50-b256-fused" for n, *_ in log)
+
+
+def test_fused_ab_keeps_plain_when_fusion_loses(monkeypatch, capsys):
+    log = []
+    res = {"resnet50-b256": {"metric": "m", "value": 2526.0,
+                             "unit": "u", "vs_baseline": 0.6},
+           "resnet50-b256-fused": {"metric": "m", "value": 2100.0,
+                                   "unit": "u", "vs_baseline": 0.5}}
+    parsed, _ = run_main(monkeypatch, capsys, [], log, results=res)
+    assert parsed["value"] == 2526.0
+    assert parsed["fused_ab_value"] == 2100.0
+
+
+def test_fused_ab_skipped_on_cpu_fallback(monkeypatch, capsys):
+    log = []
+    res = {"lenet-cpu": {"metric": "m", "value": 100.0,
+                         "unit": "u", "vs_baseline": 1.0}}
+    parsed, _ = run_main(monkeypatch, capsys, [], log, probe=False,
+                         results=res)
+    assert "fused_kernels" not in parsed
+    assert not any("fused" in n for n, *_ in log)
