@@ -190,12 +190,20 @@ def _build_workload(name, batch):
 
 
 def _transformer_flops_per_token(model, seq_len, layers=4, embed=256):
-    """~6 FLOPs/param/token for the matmul params + the attention quadratic
-    (12*S*E per layer per token, fwd+bwd)."""
+    """~6 FLOPs/param/token for the matmul params (incl. the vocab
+    projection — a real matmul) + the attention quadratic (12*S*E per
+    layer per token, fwd+bwd). Only the embedding TABLE is excluded: its
+    lookup is a gather, not FLOPs — identified by leaf identity (model[0]
+    is the LookupTable), never by shape, which would also catch the
+    same-shaped LM head."""
     import numpy as np
+    tree = model.parameter_tree()
+    embed_leaf = tree.get("0", {}).get("weight")
     n_params = 0
-    for leaf in _tree_leaves(model.parameter_tree()):
-        if getattr(leaf, "ndim", 0) >= 2 and leaf.shape[0] != 10000:
+    for leaf in _tree_leaves(tree):
+        if leaf is embed_leaf:
+            continue
+        if getattr(leaf, "ndim", 0) >= 2:
             n_params += int(np.prod(leaf.shape))
     return 6 * n_params + 12 * seq_len * embed * layers
 

@@ -162,9 +162,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, dq_ref, *,
         valid = k_pos < sk
         if causal:
             valid = valid & (k_pos <= q_pos)
-        # exp(_NEG sentinel rows - _NEG) would be 1; the valid mask zeroes
-        # them, so dead rows contribute nothing — no NaN path.
-        p = jnp.where(valid, jnp.exp(logits - lse[:, None]), 0.0)
+        # guard the exponent BEFORE exp (dead rows carry the _NEG sentinel;
+        # the raw exponent would overflow), then mask
+        expo = jnp.where(valid, logits - lse[:, None], 0.0)
+        p = jnp.where(valid, jnp.exp(expo), 0.0)
         dp = jnp.dot(do, vblk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
         return dq + jnp.dot(ds, kblk, preferred_element_type=jnp.float32)
@@ -181,8 +182,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, dq_ref, *,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref,
                     dk_ref, dv_ref, *, block_q: int, sk: int, sq: int,
                     causal: bool, scale: float, block_k: int):
-    # Per key tile: stream query tiles. Padded query rows carry dO = 0 and
-    # delta = 0, so they contribute nothing.
+    # Per key tile: stream query tiles. Padded query rows are masked out
+    # explicitly (q_pos < sq): they carry the _NEG LSE sentinel, and
+    # exp(logits - _NEG) = inf would otherwise poison dk/dv with inf*0=NaN
+    # whenever seq is not a block_q multiple.
     jkb = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)                        # (BK, D)
     v = v_ref[0].astype(jnp.float32)
@@ -200,10 +203,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref,
                          preferred_element_type=jnp.float32) * scale
         q_pos = qb * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, bk), 0)
-        valid = k_pos < sk
+        valid = (k_pos < sk) & (q_pos < sq)
         if causal:
             valid = valid & (k_pos <= q_pos)
-        p = jnp.where(valid, jnp.exp(logits - lblk[:, None]), 0.0)  # (BQ,BK)
+        # guard the exponent BEFORE exp: a padded/dead row's _NEG sentinel
+        # would overflow to inf and inf*0 -> NaN survives jnp.where
+        expo = jnp.where(valid, logits - lblk[:, None], 0.0)
+        p = jnp.where(valid, jnp.exp(expo), 0.0)            # (BQ, BK)
         dv = dv + jnp.dot(p.T, doblk, preferred_element_type=jnp.float32)
         dp = jnp.dot(doblk, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - dblk[:, None]) * scale
@@ -247,7 +253,9 @@ def _flash_bwd(q, k, v, o, lse, g_o, g_l, causal, scale, block_q, block_k,
     if pad_q:
         qt = jnp.pad(qt, ((0, 0), (0, pad_q), (0, 0)))
         dot = jnp.pad(dot, ((0, 0), (0, pad_q), (0, 0)))
-        lt = jnp.pad(lt, ((0, 0), (0, pad_q)), constant_values=_NEG)
+        # pad value is irrelevant (padded query rows are masked by
+        # q_pos < sq in both kernels); 0 keeps the exponent finite
+        lt = jnp.pad(lt, ((0, 0), (0, pad_q)))
         delta = jnp.pad(delta, ((0, 0), (0, pad_q)))
     if pad_k:
         kt = jnp.pad(kt, ((0, 0), (0, pad_k), (0, 0)))

@@ -146,8 +146,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown ring layout {layout!r}")
     if use_kernel is None:
+        import os
+        # BIGDL_TPU_FLASH_XLA_BWD's recompute backward has no LSE-cotangent
+        # plumbing, and the kernel-hop combine differentiates through lse —
+        # the A/B lever must push the ring back to the XLA partial path.
         use_kernel = (layout == "contiguous"
-                      and jax.default_backend() == "tpu")
+                      and jax.default_backend() == "tpu"
+                      and not os.environ.get("BIGDL_TPU_FLASH_XLA_BWD"))
     if use_kernel and layout == "zigzag":
         raise ValueError("the Pallas hop kernel supports contiguous causal "
                          "masking only; zigzag uses the XLA partial path")

@@ -154,6 +154,30 @@ class TestFlashKernel:
                                    np.asarray(jax.grad(loss_plain)(q)),
                                    rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.parametrize("s,causal", [(20, False), (20, True)])
+    def test_bwd_kernel_ragged_seq_not_block_multiple(self, s, causal):
+        # seq NOT a multiple of block_q: padded query rows carry the LSE
+        # sentinel and must be masked in the dK/dV kernel — regression for
+        # the inf*0=NaN path (round-3 review finding)
+        b, n, d = 1, 2, 8
+        q, k, v = (jnp.asarray(_rand(b, s, n, d)) for _ in range(3))
+        g = jnp.asarray(_rand(b, s, n, d))
+
+        def run(f):
+            _, vjp = jax.vjp(f, q, k, v)
+            return vjp(g)
+
+        ref = run(lambda q_, k_, v_: ac.dot_product_attention(
+            q_, k_, v_, causal=causal))
+        got = run(lambda q_, k_, v_: flash_attention(
+            q_, k_, v_, causal=causal, block_q=16, block_k=16,
+            interpret=True))
+        for r, o, name in zip(ref, got, "qkv"):
+            assert np.isfinite(np.asarray(o)).all(), f"d{name} has NaN/inf"
+            np.testing.assert_allclose(np.asarray(o), np.asarray(r),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f"d{name} mismatch")
+
     @pytest.mark.parametrize("s,causal", [(32, False), (32, True), (40, True),
                                           (24, False)])
     def test_bwd_kernel_all_grads_match_plain(self, s, causal):
