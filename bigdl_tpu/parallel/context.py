@@ -30,8 +30,10 @@ sequence chunks, so later devices do more causal work than earlier ones
 and the ring serialises on the last. ``layout="zigzag"`` gives every
 device an (early, late) chunk pair — chunk ``i`` and chunk ``2P-1-i`` —
 balancing per-hop FLOPs across the ring (the standard striped fix).
-Zigzag shards are non-contiguous, so causal masking uses explicit global
-position vectors and the XLA partial path.
+Zigzag composes with the kernel hops too: each hop runs the flash kernel
+on the 4 contiguous half-chunk pairs and folds them with the LSE
+combine; the XLA partial path instead masks with explicit global
+position vectors.
 """
 
 from __future__ import annotations
@@ -65,13 +67,16 @@ def _lse_combine(o_a, l_a, o_b, l_b):
     return o_new, l_new
 
 
-def _ring_hop_kernel(q, kc, vc, scale, src, my, chunk, causal, interpret):
-    """One ring hop's (o, lse) partial via the Pallas flash kernel.
+def _ring_hop_kernel(q, kc, vc, scale, kv_chunk, q_chunk, causal, interpret):
+    """One (q chunk, kv chunk) pair's (o, lse) partial via the Pallas flash
+    kernel.
 
-    Causal classification per hop: kv chunks strictly in the past are
-    unmasked, the diagonal chunk runs the kernel's causal path, future
-    chunks contribute the empty partial — all three as ``lax.switch``
-    branches since ``src`` is traced.
+    Causal classification: kv chunks strictly in the past are unmasked, the
+    diagonal chunk runs the kernel's causal path, future chunks contribute
+    the empty partial — all three as ``lax.switch`` branches since the
+    chunk ids are traced. Chunks must be CONTIGUOUS sequence spans (the
+    kernel's causal mask is positional within the pair); zigzag callers
+    pass each contiguous half separately.
     """
     from bigdl_tpu.ops.flash_attention import flash_attention_with_lse
 
@@ -93,8 +98,33 @@ def _ring_hop_kernel(q, kc, vc, scale, src, my, chunk, causal, interpret):
         l = jnp.sum(o, axis=-1).transpose(0, 2, 1) + _NEG
         return o, l
 
-    idx = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+    idx = jnp.where(kv_chunk < q_chunk, 0,
+                    jnp.where(kv_chunk == q_chunk, 1, 2))
     return lax.switch(idx, [full, diag, skip], None)
+
+
+def _zigzag_hop_kernel(q, kc, vc, scale, src, my, p, causal, interpret):
+    """One zigzag hop's (o, lse) partial: the local shard is the
+    contiguous-chunk pair (my, 2P-1-my) and the kv shard is the pair
+    (src, 2P-1-src); run the flash kernel on the 4 contiguous half-chunk
+    combinations and fold the kv halves per q half."""
+    c2 = q.shape[1] // 2
+    halves_q = ((q[:, :c2], my), (q[:, c2:], 2 * p - 1 - my))
+    halves_kv = ((kc[:, :c2], vc[:, :c2], src),
+                 (kc[:, c2:], vc[:, c2:], 2 * p - 1 - src))
+    outs = []
+    for qh, qid in halves_q:
+        o, l = None, None
+        for kh, vh, kid in halves_kv:
+            oh, lh = _ring_hop_kernel(qh, kh, vh, scale, kid, qid, causal,
+                                      interpret)
+            if o is None:
+                o, l = oh, lh
+            else:
+                o, l = _lse_combine(o, l, oh, lh)
+        outs.append((o, l))
+    return (jnp.concatenate([outs[0][0], outs[1][0]], axis=1),
+            jnp.concatenate([outs[0][1], outs[1][1]], axis=2))
 
 
 def zigzag_permutation(seq_len: int, p: int) -> np.ndarray:
@@ -150,12 +180,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # BIGDL_TPU_FLASH_XLA_BWD's recompute backward has no LSE-cotangent
         # plumbing, and the kernel-hop combine differentiates through lse —
         # the A/B lever must push the ring back to the XLA partial path.
-        use_kernel = (layout == "contiguous"
-                      and jax.default_backend() == "tpu"
+        use_kernel = (jax.default_backend() == "tpu"
                       and not os.environ.get("BIGDL_TPU_FLASH_XLA_BWD"))
-    if use_kernel and layout == "zigzag":
-        raise ValueError("the Pallas hop kernel supports contiguous causal "
-                         "masking only; zigzag uses the XLA partial path")
     p = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     chunk = q.shape[1]
@@ -169,8 +195,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         def hop(t, carry):
             o, lse, kc, vc = carry
             src = (my - t) % p
-            oh, lh = _ring_hop_kernel(q, kc, vc, scale, src, my, chunk,
-                                      causal, interpret)
+            if layout == "zigzag":
+                oh, lh = _zigzag_hop_kernel(q, kc, vc, scale, src, my, p,
+                                            causal, interpret)
+            else:
+                oh, lh = _ring_hop_kernel(q, kc, vc, scale, src, my,
+                                          causal, interpret)
             o, lse = _lse_combine(o, lse, oh, lh)
             kc = lax.ppermute(kc, axis_name, perm)
             vc = lax.ppermute(vc, axis_name, perm)
