@@ -54,13 +54,16 @@ def test_parser(prog: str, default_batch: int = 128) -> argparse.ArgumentParser:
 def build_optimizer(model, train_set, criterion, args,
                     validation_set=None,
                     methods=None,
-                    optim_method=None) -> Optimizer:
+                    optim_method=None,
+                    topology=None) -> Optimizer:
     """The per-model ``Train.scala`` body: optimizer + schedules + triggers
     + checkpoint + summaries, from parsed args. ``optim_method`` overrides
     the default SGD (e.g. textclassifier uses Adagrad, reference
-    ``example/textclassification/TextClassifier.scala:241``)."""
+    ``example/textclassification/TextClassifier.scala:241``); ``topology``
+    a non-default ``MeshTopology`` (tensor/expert axes)."""
     redirect_logs()
-    opt = Optimizer(model, train_set, criterion)
+    kwargs = {"topology": topology} if topology is not None else {}
+    opt = Optimizer(model, train_set, criterion, **kwargs)
     opt.set_optim_method(optim_method or SGD(
         learningrate=args.learningRate,
         learningrate_decay=args.learningRateDecay,

@@ -50,15 +50,22 @@ def train(argv) -> None:
     parser.add_argument("--contextParallel", default=None,
                         choices=[None, "ring", "ulysses"],
                         help="shard the sequence axis over the mesh")
+    parser.add_argument("--tensorParallel", type=int, default=1,
+                        help="Megatron TP degree (dp x tp mesh); adds "
+                        "sequence-parallel regions when seqLen divides")
     parser.add_argument("--ringLayout", default="contiguous",
                         choices=["contiguous", "zigzag"],
                         help="ring shard layout; zigzag balances causal "
                         "work across devices (ring mode only)")
     args = parser.parse_args(argv)
 
+    if args.contextParallel and args.tensorParallel > 1:
+        raise SystemExit("--contextParallel and --tensorParallel are "
+                         "separate modes; pick one")
     samples = _synthetic_corpus(max(args.synthetic_size, args.batchSize),
                                 args.seqLen, args.vocab)
-    ds = DataSet.array(samples).transform(
+    ds = DataSet.array(samples,
+                       distributed=args.tensorParallel > 1).transform(
         SampleToBatch(batch_size=args.batchSize))
 
     model = transformer.build_lm(
@@ -75,6 +82,24 @@ def train(argv) -> None:
             raise SystemExit("--model/--state resume is not supported with "
                              "--contextParallel yet")
         trained = _train_context_parallel(model, criterion, ds, args)
+    elif args.tensorParallel > 1:
+        # dp x tp mesh through the standard Optimizer path: Megatron specs
+        # are inferred per layer; SP regions shard the norm/dropout
+        # segments when the sequence divides the tp degree
+        import jax
+        from bigdl_tpu.parallel.mesh import MeshTopology
+        from bigdl_tpu.parallel.tensor_parallel import \
+            enable_sequence_parallel
+        n = len(jax.devices())
+        tp = args.tensorParallel
+        if n % tp != 0:
+            raise SystemExit(f"--tensorParallel {tp} must divide the "
+                             f"device count {n}")
+        topo = MeshTopology(data=n // tp, tensor=tp)
+        if args.seqLen % tp == 0:
+            enable_sequence_parallel(model, topo.build())
+        opt = build_optimizer(model, ds, criterion, args, topology=topo)
+        trained = opt.optimize()
     else:
         opt = build_optimizer(model, ds, criterion, args)
         trained = opt.optimize()

@@ -179,6 +179,10 @@ class TestPerfHarness:
                            "--synthetic-size", "16", "--numHeads", "4",
                            "--contextParallel", "ring",
                            "--ringLayout", "zigzag"])
+        # dp=2 x tp=4 with Megatron-SP regions through the Optimizer path
+        transformer.train(["-b", "8", "--seqLen", "32", "-e", "1",
+                           "--synthetic-size", "16", "--numHeads", "4",
+                           "--tensorParallel", "4"])
 
     def test_context_parallel_matches_sequential_loss(self):
         # PE offsets + pmean correctness: first-step loss of the seq-parallel
