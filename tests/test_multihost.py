@@ -88,3 +88,53 @@ def test_multi_process_training_matches_single_process(tmp_path, n_procs,
         for m, s in zip(multi, single):
             np.testing.assert_allclose(m, s, rtol=2e-4, atol=2e-5,
                                        err_msg=sync_mode)
+
+
+RING_WORKER = os.path.join(os.path.dirname(__file__),
+                           "multihost_ring_worker.py")
+
+
+@pytest.mark.slow
+def test_multi_process_ring_attention_matches_single_process(tmp_path):
+    # Ring attention with the seq axis spanning PROCESS boundaries: the
+    # ppermute hops ride the inter-process transport (SURVEY §5.7 + §5.8
+    # together on a real multi-host topology).
+    n_procs = 2
+    port = 29000 + (os.getpid() % 250) * 4 + 3
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, RING_WORKER, str(pid), str(n_procs), str(port),
+         str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in range(n_procs)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out.decode(errors="replace"))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"ring worker {pid} failed:\n{out[-3000:]}"
+
+    scalars = np.load(tmp_path / "ring_scalars.npz")
+
+    # single-process oracle on the identical inputs
+    import jax.numpy as jnp
+    from bigdl_tpu.ops import attention_core as ac
+    b, s, n, d = 2, 8 * (2 * n_procs), 2, 8
+    rng = np.random.default_rng(7)
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (b, s, n, d))
+                           .astype(np.float32)) for _ in range(3))
+    out = ac.dot_product_attention(q, k, v, causal=True)
+    want_loss = float(jnp.sum(out.astype(jnp.float32) ** 2))
+    g = jax.grad(lambda q_: jnp.sum(ac.dot_product_attention(
+        q_, k, v, causal=True).astype(jnp.float32) ** 2))(q)
+    want_gnorm = float(jnp.sum(g ** 2))
+    np.testing.assert_allclose(float(scalars["loss"]), want_loss,
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(scalars["gnorm"]), want_gnorm,
+                               rtol=1e-4)
