@@ -50,6 +50,9 @@ def train(argv) -> None:
     parser.add_argument("--contextParallel", default=None,
                         choices=[None, "ring", "ulysses"],
                         help="shard the sequence axis over the mesh")
+    parser.add_argument("--moeExperts", type=int, default=0,
+                        help="replace the FFN with a top-2 routed MoE of "
+                        "this many experts (0 = dense)")
     parser.add_argument("--tensorParallel", type=int, default=1,
                         help="Megatron TP degree (dp x tp mesh); adds "
                         "sequence-parallel regions when seqLen divides")
@@ -74,7 +77,8 @@ def train(argv) -> None:
         seq_axis="seq" if args.contextParallel else None,
         seq_mode=args.contextParallel or "ring",
         seq_layout=args.ringLayout if args.contextParallel == "ring"
-        else "contiguous")
+        else "contiguous",
+        moe_experts=args.moeExperts)
     criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
 
     if args.contextParallel:

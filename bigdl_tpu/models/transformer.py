@@ -20,7 +20,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
              max_len: int = 1024, dropout: float = 0.0,
              seq_axis: Optional[str] = None,
              seq_mode: str = "ring",
-             seq_layout: str = "contiguous") -> nn.Sequential:
+             seq_layout: str = "contiguous",
+             moe_experts: int = 0, moe_k: int = 2) -> nn.Sequential:
     """Causal LM: 1-based token ids (N, T) -> log-probs (N, T, vocab).
 
     ``seq_axis="seq"`` shards every attention layer over the mesh sequence
@@ -36,6 +37,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
             .add(nn.TransformerEncoder(num_layers, embed_dim, num_heads,
                                        ffn_dim, dropout=dropout, causal=True,
                                        seq_axis=seq_axis, seq_mode=seq_mode,
-                                       seq_layout=seq_layout))
+                                       seq_layout=seq_layout,
+                                       moe_experts=moe_experts,
+                                       moe_k=moe_k))
             .add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size)))
             .add(nn.LogSoftMax()))

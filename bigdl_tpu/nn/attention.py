@@ -219,21 +219,31 @@ class TransformerEncoderLayer(Module):
                  dropout: float = 0.0, activation: str = "gelu",
                  pre_norm: bool = True, causal: bool = False,
                  block_size: int = 0, seq_axis: Optional[str] = None,
-                 seq_mode: str = "ring", seq_layout: str = "contiguous"):
+                 seq_mode: str = "ring", seq_layout: str = "contiguous",
+                 moe_experts: int = 0, moe_k: int = 2):
         super().__init__()
         from bigdl_tpu.nn.linear import Linear
         from bigdl_tpu.nn.regularization import Dropout
         self.pre_norm = pre_norm
         self.drop = Dropout(dropout)
         self.activation = activation
+        self.moe_experts = moe_experts
         self.self_attn = MultiHeadAttention(embed_dim, num_heads,
                                             dropout=dropout, causal=causal,
                                             block_size=block_size,
                                             seq_axis=seq_axis,
                                             seq_mode=seq_mode,
                                             seq_layout=seq_layout)
-        self.linear1 = Linear(embed_dim, ffn_dim)
-        self.linear2 = Linear(ffn_dim, embed_dim)
+        if moe_experts:
+            # MoE FFN: top-k routed expert MLPs replace the dense pair;
+            # under expert parallelism the stacked expert leaves shard
+            # over the mesh 'expert' axis (parallel/expert.py)
+            from bigdl_tpu.parallel.expert import MoE
+            self.moe = MoE(embed_dim, ffn_dim, n_experts=moe_experts,
+                           k=moe_k, activation=activation)
+        else:
+            self.linear1 = Linear(embed_dim, ffn_dim)
+            self.linear2 = Linear(ffn_dim, embed_dim)
         self.norm1 = LayerNorm(embed_dim)
         self.norm2 = LayerNorm(embed_dim)
 
@@ -246,6 +256,11 @@ class TransformerEncoderLayer(Module):
 
     def _drop(self, x):
         return self.drop.forward(x)
+
+    def _ffn(self, x):
+        if self.moe_experts:
+            return self.moe.forward(x)
+        return self.linear2.forward(self._act(self.linear1.forward(x)))
 
     def update_output(self, input):
         # Megatron sequence-parallel regions: when tagged by
@@ -262,11 +277,10 @@ class TransformerEncoderLayer(Module):
         x = _c(input)
         if self.pre_norm:
             x = _c(x + self._drop(self.self_attn.forward(self.norm1.forward(x))))
-            h = self.linear2.forward(self._act(self.linear1.forward(
-                self.norm2.forward(x))))
+            h = self._ffn(self.norm2.forward(x))
             return _c(x + self._drop(h))
         x = _c(self.norm1.forward(x + self._drop(self.self_attn.forward(x))))
-        h = self.linear2.forward(self._act(self.linear1.forward(x)))
+        h = self._ffn(x)
         return _c(self.norm2.forward(x + self._drop(h)))
 
 
@@ -277,7 +291,8 @@ class TransformerEncoder(Module):
                  ffn_dim: int, dropout: float = 0.0, activation: str = "gelu",
                  pre_norm: bool = True, causal: bool = False,
                  block_size: int = 0, seq_axis: Optional[str] = None,
-                 seq_mode: str = "ring", seq_layout: str = "contiguous"):
+                 seq_mode: str = "ring", seq_layout: str = "contiguous",
+                 moe_experts: int = 0, moe_k: int = 2):
         super().__init__()
         self.num_layers = num_layers
         for i in range(num_layers):
@@ -285,7 +300,7 @@ class TransformerEncoder(Module):
                 embed_dim, num_heads, ffn_dim, dropout=dropout,
                 activation=activation, pre_norm=pre_norm, causal=causal,
                 block_size=block_size, seq_axis=seq_axis, seq_mode=seq_mode,
-                seq_layout=seq_layout))
+                seq_layout=seq_layout, moe_experts=moe_experts, moe_k=moe_k))
         self.final_norm = LayerNorm(embed_dim) if pre_norm else None
         if self.final_norm is not None:
             self.add_module("final_norm", self.final_norm)

@@ -183,6 +183,9 @@ class TestPerfHarness:
         transformer.train(["-b", "8", "--seqLen", "32", "-e", "1",
                            "--synthetic-size", "16", "--numHeads", "4",
                            "--tensorParallel", "4"])
+        # MoE FFN variant (top-2 of 4 experts)
+        transformer.train(["-b", "8", "--seqLen", "32", "-e", "1",
+                           "--synthetic-size", "16", "--moeExperts", "4"])
 
     def test_context_parallel_matches_sequential_loss(self):
         # PE offsets + pmean correctness: first-step loss of the seq-parallel
