@@ -108,6 +108,9 @@ def _zigzag_hop_kernel(q, kc, vc, scale, src, my, p, causal, interpret):
     contiguous-chunk pair (my, 2P-1-my) and the kv shard is the pair
     (src, 2P-1-src); run the flash kernel on the 4 contiguous half-chunk
     combinations and fold the kv halves per q half."""
+    if not causal:
+        # position-independent: one full-chunk launch, no split/fold cost
+        return _ring_hop_kernel(q, kc, vc, scale, 0, 0, False, interpret)
     c2 = q.shape[1] // 2
     halves_q = ((q[:, :c2], my), (q[:, c2:], 2 * p - 1 - my))
     halves_kv = ((kc[:, :c2], vc[:, :c2], src),

@@ -123,6 +123,8 @@ def _tag_children(module) -> None:
     """
     from bigdl_tpu import nn
     if isinstance(module, nn.TransformerEncoderLayer):
+        if getattr(module, "moe_experts", 0):
+            return  # MoE FFN: _module_specs shards the expert leaves
         if not hasattr(module.linear1, "tp_mode"):
             module.linear1.tp_mode = COLUMN
         if not hasattr(module.linear2, "tp_mode"):

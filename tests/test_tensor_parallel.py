@@ -209,3 +209,13 @@ class TestSequenceParallelRegions:
             enc_sp, p, buffers, x, training=False))(params)
         np.testing.assert_allclose(np.asarray(y_sp), np.asarray(y_plain),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_moe_transformer_layer_specs_no_crash():
+    # TP tagging must not dereference linear1 on an MoE-FFN block
+    # (regression: --tensorParallel + --moeExperts crashed)
+    layer = nn.TransformerEncoderLayer(16, 2, 32, moe_experts=4)
+    specs = infer_param_specs(layer, axis_size=2)
+    # expert leaves shard over the expert axis; attention stays Megatron
+    assert specs["moe"]["w1"] == P("expert", None, None)
+    assert specs["self_attn"]["in_proj_weight"] == P("tensor", None)
