@@ -194,3 +194,36 @@ def test_vgg_and_inception_adopt_fused_3x3(monkeypatch):
     assert "FusedConv3x3BN" in repr(inception.build_v2(10))
     out = vgg.build(10).forward(jnp.zeros((1, 32, 32, 3)))
     assert out.shape == (1, 10)
+
+
+def test_fused_kernels_under_bf16_policy(monkeypatch):
+    # the on-chip A/B command runs bf16 compute params through the fused
+    # kernels; one jitted step must run and produce finite f32-master grads
+    monkeypatch.setenv("BIGDL_TPU_FUSED_1X1", "1")
+    monkeypatch.setenv("BIGDL_TPU_FUSED_3X3", "1")
+    from bigdl_tpu.models import resnet
+    from bigdl_tpu.ops.precision import DtypePolicy, cast_tree
+
+    model = resnet.build_cifar(class_num=4, depth=8)
+    assert "FusedConv3x3BN" in repr(model)
+    policy = DtypePolicy.bf16()
+    params, buffers = model.parameter_tree(), model.buffer_tree()
+    x = _rand(4, 32, 32, 3)
+    y = jnp.asarray(np.asarray([1.0, 2.0, 3.0, 4.0]))
+    crit = nn.ClassNLLCriterion()
+
+    @jax.jit
+    def step(p):
+        def loss_fn(p):
+            p_c = policy.cast_params_for_compute(p)
+            out, new_buf = functional_apply(model, p_c, buffers, x,
+                                            training=True)
+            return crit.apply(out, y).astype(jnp.float32), new_buf
+        (loss, new_buf), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        return loss, g
+
+    loss, g = step(params)
+    assert np.isfinite(float(loss))
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+        assert leaf.dtype == jnp.float32  # master grads stay f32
