@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Flash-attention block-size autotune (run on a real TPU).
+
+Sweeps (block_q, block_k) for the benchmarked attention shapes and prints
+per-config times plus the winning env setting:
+
+    python scripts/tune_flash.py                      # transformer bench shape
+    python scripts/tune_flash.py --b 8 --s 2048 --d 64 --heads 8 --causal
+
+The winner is exported by setting BIGDL_TPU_FLASH_BLOCK_Q/K (consumed by
+``ops.flash_attention`` at call time — no code edits). On CPU this runs
+interpret mode with tiny defaults purely as a smoke test.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--b", type=int, default=None)
+    ap.add_argument("--s", type=int, default=None)
+    ap.add_argument("--heads", type=int, default=None)
+    ap.add_argument("--d", type=int, default=None)
+    ap.add_argument("--causal", action="store_true")
+    ap.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+    ap.add_argument("--blocks", default="128,256,512",
+                    help="comma-separated candidate block sizes")
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    from bigdl_tpu.utils.platform import ensure_platform
+    ensure_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.ops.flash_attention import flash_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        print("WARNING: not a TPU backend - interpret-mode smoke only",
+              flush=True)
+    # defaults: the bench transformer attention shape on TPU, tiny on CPU
+    b = args.b or (32 if on_tpu else 1)
+    s = args.s or (512 if on_tpu else 64)
+    n = args.heads or (4 if on_tpu else 2)
+    d = args.d or 64
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    blocks = [int(x) for x in args.blocks.split(",")]
+    if not on_tpu:
+        blocks = [16, 32]
+
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (b, s, n, d)), dtype)
+               for _ in range(3))
+
+    def timed(f, *xs):
+        f(*xs)[0].block_until_ready() if isinstance(f(*xs), tuple) \
+            else jax.block_until_ready(f(*xs))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = f(*xs)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / args.iters
+
+    results = []
+    for bq in blocks:
+        for bk in blocks:
+            fwd = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, causal=args.causal, block_q=bq, block_k=bk))
+
+            def loss(q, k, v, bq=bq, bk=bk):
+                return jnp.sum(flash_attention(
+                    q, k, v, causal=args.causal, block_q=bq,
+                    block_k=bk).astype(jnp.float32) ** 2)
+
+            bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            try:
+                t_f = timed(fwd, q, k, v)
+                t_b = timed(bwd, q, k, v)
+            except Exception as e:
+                print(f"bq={bq:4d} bk={bk:4d}  FAILED: "
+                      f"{type(e).__name__}: {str(e)[:90]}", flush=True)
+                continue
+            results.append((t_f + t_b, bq, bk, t_f, t_b))
+            print(f"bq={bq:4d} bk={bk:4d}  fwd {t_f * 1e3:8.3f} ms   "
+                  f"fwd+bwd-grad {t_b * 1e3:8.3f} ms", flush=True)
+
+    if not results:
+        print("no config succeeded")
+        sys.exit(1)
+    _, bq, bk, t_f, t_b = min(results)
+    print(f"\nbest: BIGDL_TPU_FLASH_BLOCK_Q={bq} BIGDL_TPU_FLASH_BLOCK_K={bk}"
+          f"  (fwd {t_f * 1e3:.3f} ms, bwd {t_b * 1e3:.3f} ms; "
+          f"shape b={b} s={s} h={n} d={d} causal={args.causal} "
+          f"{args.dtype})")
+
+
+if __name__ == "__main__":
+    main()
