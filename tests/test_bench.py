@@ -155,3 +155,36 @@ def test_fused_ab_skipped_on_cpu_fallback(monkeypatch, capsys):
                          results=res)
     assert "fused_kernels" not in parsed
     assert not any("fused" in n for n, *_ in log)
+
+
+def test_all_mode_one_line_per_workload(monkeypatch, capsys):
+    # --all emits one JSON line per BASELINE workload, falling down each
+    # model's ladder independently; dead-TPU probe limits it to CPU
+    # fallbacks but still covers every model
+    log = []
+    res = {f"{m}-cpu": {"metric": f"{m}_x", "value": 1.0 + i,
+                        "unit": "u", "vs_baseline": 0.1}
+           for i, m in enumerate(bench._MODELS)}
+    results = dict(res)
+
+    def fake_attempt(name, worker, batch, steps, budget, platform="",
+                     precision="bf16", grace=90, extra_env=None):
+        log.append((name, platform))
+        return results.get(name)
+
+    monkeypatch.setattr(bench, "_attempt", fake_attempt)
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: False)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--all"])
+    monkeypatch.setattr(bench, "_T_START", bench.time.monotonic())
+    code = 0
+    try:
+        bench.main()
+    except SystemExit as e:
+        code = e.code or 0
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines()]
+    assert code == 0
+    assert len(lines) == len(bench._MODELS)
+    assert {l["model"] for l in lines} == set(bench._MODELS)
+    # dead probe: no TPU attempts were made at all
+    assert all(p == "cpu" for _, p in log)
