@@ -306,7 +306,8 @@ def circular_apply(stack: PipelineStack, local_params, x, n_micro: int,
 def gpipe_loss_fn(stack: PipelineStack, criterion, mesh,
                   n_micro: int, axis_name: str = PIPELINE_AXIS,
                   head: Optional[Callable] = None, remat: bool = False,
-                  interleave: int = 1):
+                  interleave: int = 1,
+                  data_axis: Optional[str] = None):
     """(stacked_params, head_params, x, labels) -> scalar loss, jittable;
     with a buffered stack the signature gains a buffers argument and the
     return becomes ``(loss, new_buffers)``.
@@ -316,15 +317,25 @@ def gpipe_loss_fn(stack: PipelineStack, criterion, mesh,
     (replicated — run it on every stage; it is tiny relative to the stack).
     ``interleave=V > 1`` selects the circular schedule (pass parameters
     pre-permuted with ``circular_permutation``).
+
+    ``data_axis``: dp x pp composition — the batch shards over this mesh
+    axis (each data group runs an independent pipeline over its slice)
+    and the per-group mean losses ``pmean`` into the global loss, so
+    ``jax.grad`` yields dp-averaged gradients exactly like
+    DistriOptimizer's allreduce plane.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     p_specs = pipeline_spec_tree(stack, axis_name)
+    x_spec = P(data_axis) if data_axis else P()
 
     if stack.has_buffers:
         assert interleave == 1, \
             "circular schedule supports buffer-free stacks only"
+        assert data_axis is None, (
+            "buffered stacks under dp would need cross-group stat "
+            "merging; use buffer-free blocks with data_axis")
         b_specs = jax.tree_util.tree_map(
             lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))),
             stack.buffer_tree())
@@ -352,10 +363,12 @@ def gpipe_loss_fn(stack: PipelineStack, criterion, mesh,
                                 training=True, remat=remat)
         logits = head(head_params, feats) if head is not None else feats
         loss = criterion.apply(logits, labels).astype(jnp.float32)
+        if data_axis:
+            loss = lax.pmean(loss, data_axis)
         return loss
 
     return shard_map(
         local_fn, mesh=mesh,
-        in_specs=(p_specs, P(), P(), P()),
+        in_specs=(p_specs, P(), x_spec, x_spec),
         out_specs=P(),
         check_vma=False)
