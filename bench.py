@@ -249,7 +249,10 @@ def worker_train(name, batch, steps, budget_s, precision="bf16",
     # step costs ~15 ms on the tunneled backend — measured 99 ms on-device
     # vs 114 ms wall without this). Constant input per step matches the
     # reference harness's constant-data mode (DistriOptimizerPerf.scala:32).
-    K = 5
+    # On CPU fallbacks there is no RPC to amortize and steps are seconds
+    # long — K=1 keeps the budget checks fine-grained so slow workers
+    # emit partial numbers instead of dying at the timeout.
+    K = 5 if jax.default_backend() == "tpu" else 1
 
     def multi_step(params, buffers, opt_state, data, labels):
         def body(_, st):
