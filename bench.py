@@ -420,7 +420,7 @@ _LADDERS = {
     "transformer": [(32, 20, 420), (8, 10, 300)],
 }
 _CPU_FALLBACK = {  # small shapes that finish on CPU in minutes
-    "resnet50": (32, 10, 300), "vgg16": (8, 5, 300),
+    "resnet50": (16, 5, 420), "vgg16": (8, 5, 300),
     "inception_v1": (16, 5, 300), "lenet": (512, 50, 180),
     "lstm": (32, 5, 300), "transformer": (4, 5, 300),
 }
