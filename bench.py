@@ -365,7 +365,8 @@ def _attempt(name, worker, batch, steps, budget_s, platform="",
                     if platform == "cpu":
                         res["note"] = ("CPU fallback - TPU backend was "
                                        "unreachable; value is NOT a TPU "
-                                       "number")
+                                       "number. Staged on-chip commands: "
+                                       "PERF.md round-3 table")
                 return res
             except json.JSONDecodeError:
                 continue
