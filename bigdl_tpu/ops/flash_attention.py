@@ -48,7 +48,11 @@ _NEG = float(jnp.finfo(jnp.float32).min)
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int, sk: int,
                 causal: bool, scale: float, block_q: int):
     # q_ref: (1, BQ, D); k_ref/v_ref: (1, Sk_pad, D); o_ref: (1, BQ, D);
-    # l_ref: (1, BQ) row logsumexp of the scaled, masked logits.
+    # l_ref: (1, 1, BQ) row logsumexp of the scaled, masked logits. The
+    # LSE rides a (BH, 1, S) array so its block's penultimate dim equals
+    # the array dim — the real TPU lowering rejects (1, BQ) blocks over a
+    # (BH, S) array (last-two-dims divisibility rule; interpret mode does
+    # not enforce it, which is how this shipped unverified in round 2).
     j = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale                # (BQ, D)
     bq, d = q.shape
@@ -94,7 +98,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int, sk: int,
     o_ref[0] = (acc / rsum_safe[:, None]).astype(o_ref.dtype)
     # Dead rows keep the finite _NEG sentinel (NOT -inf): downstream
     # logaddexp-style combines stay NaN-free on all-masked rows.
-    l_ref[0] = jnp.where(dead, _NEG, rmax + jnp.log(rsum_safe))
+    l_ref[0, 0] = jnp.where(dead, _NEG, rmax + jnp.log(rsum_safe))
 
 
 def _flash_fwd_lse(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -121,7 +125,7 @@ def _flash_fwd_lse(q, k, v, causal, scale, block_q, block_k, interpret):
         functools.partial(_fwd_kernel, block_k=block_k, sk=sk,
                           causal=causal, scale=scale, block_q=block_q),
         out_shape=(jax.ShapeDtypeStruct((b * n, sq_p, d), q.dtype),
-                   jax.ShapeDtypeStruct((b * n, sq_p), jnp.float32)),
+                   jax.ShapeDtypeStruct((b * n, 1, sq_p), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
@@ -129,11 +133,11 @@ def _flash_fwd_lse(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, sk_p, d), lambda i, j: (i, 0, 0)),
         ],
         out_specs=(pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-                   pl.BlockSpec((1, block_q), lambda i, j: (i, j))),
+                   pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j))),
         interpret=interpret,
     )(qt, kt, vt)
     out = out[:, :sq].reshape(b, n, sq, d).transpose(0, 2, 1, 3)
-    lse = lse[:, :sq].reshape(b, n, sq)
+    lse = lse[:, 0, :sq].reshape(b, n, sq)
     return out, lse
 
 
@@ -146,8 +150,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, dq_ref, *,
     j = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)                        # (BQ, D)
     do = do_ref[0].astype(jnp.float32)                      # (BQ, D)
-    lse = l_ref[0]                                          # (BQ,)
-    delta = d_ref[0]                                        # (BQ,)
+    lse = l_ref[0, 0]                                       # (BQ,)
+    delta = d_ref[0, 0]                                     # (BQ,)
     bq, d = q.shape
     nkb = k_ref.shape[1] // block_k
     q_pos = j * block_q + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
@@ -197,8 +201,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref,
         dk, dv = carry
         qblk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         doblk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lblk = l_ref[0, pl.ds(qb * block_q, block_q)]       # (BQ,)
-        dblk = d_ref[0, pl.ds(qb * block_q, block_q)]       # (BQ,)
+        lblk = l_ref[0, 0, pl.ds(qb * block_q, block_q)]    # (BQ,)
+        dblk = d_ref[0, 0, pl.ds(qb * block_q, block_q)]    # (BQ,)
         logits = jnp.dot(qblk, k.T,
                          preferred_element_type=jnp.float32) * scale
         q_pos = qb * block_q + lax.broadcasted_iota(
@@ -240,13 +244,15 @@ def _flash_bwd(q, k, v, o, lse, g_o, g_l, causal, scale, block_q, block_k,
     vt = v.transpose(0, 2, 1, 3).reshape(b * n, sk, d)
     dot = g_o.transpose(0, 2, 1, 3).reshape(b * n, sq, d)
     ot = o.transpose(0, 2, 1, 3).reshape(b * n, sq, d)
-    lt = lse.reshape(b * n, sq)
+    # lse/delta ride (BH, 1, S) arrays (see _fwd_kernel: the TPU lowering
+    # rejects (1, BQ) blocks over a (BH, S) array).
+    lt = lse.reshape(b * n, 1, sq)
     # delta_i = rowsum(dO_i * O_i) - g_lse_i (the LSE cotangent enters the
     # softmax jacobian exactly where the diagonal correction sits).
     delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
-                    axis=-1)
+                    axis=-1)[:, None, :]
     if g_l is not None:
-        delta = delta - g_l.reshape(b * n, sq).astype(jnp.float32)
+        delta = delta - g_l.reshape(b * n, 1, sq).astype(jnp.float32)
 
     pad_q = (-sq) % block_q
     pad_k = (-sk) % block_k
@@ -255,8 +261,8 @@ def _flash_bwd(q, k, v, o, lse, g_o, g_l, causal, scale, block_q, block_k,
         dot = jnp.pad(dot, ((0, 0), (0, pad_q), (0, 0)))
         # pad value is irrelevant (padded query rows are masked by
         # q_pos < sq in both kernels); 0 keeps the exponent finite
-        lt = jnp.pad(lt, ((0, 0), (0, pad_q)))
-        delta = jnp.pad(delta, ((0, 0), (0, pad_q)))
+        lt = jnp.pad(lt, ((0, 0), (0, 0), (0, pad_q)))
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q)))
     if pad_k:
         kt = jnp.pad(kt, ((0, 0), (0, pad_k), (0, 0)))
         vt = jnp.pad(vt, ((0, 0), (0, pad_k), (0, 0)))
@@ -272,8 +278,8 @@ def _flash_bwd(q, k, v, o, lse, g_o, g_l, causal, scale, block_q, block_k,
             pl.BlockSpec((1, sk_p, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, sk_p, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         interpret=interpret,
@@ -290,8 +296,8 @@ def _flash_bwd(q, k, v, o, lse, g_o, g_l, causal, scale, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, sq_p, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq_p), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, sq_p), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, 1, sq_p), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, sq_p), lambda i, j: (i, 0, 0)),
         ],
         out_specs=(pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
                    pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))),
