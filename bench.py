@@ -134,6 +134,13 @@ _FWD_MACS = {
 _SEQ_LEN = {"lstm": 128, "transformer": 512}
 
 
+def _apply_seq_len_override(args):
+    """--seq-len (worker only): bench the sequence workloads at other
+    lengths (e.g. the long-context transformer crossover, PERF.md)."""
+    if args.seq_len:
+        _SEQ_LEN["lstm"] = _SEQ_LEN["transformer"] = args.seq_len
+
+
 def _build_workload(name, batch):
     import jax.numpy as jnp
     import numpy as np
@@ -175,8 +182,10 @@ def _build_workload(name, batch):
     if name == "transformer":
         from bigdl_tpu.models import transformer
         t = _SEQ_LEN["transformer"]
-        # embed 256 / 4 heads -> head dim 64: the config the flash-attention
-        # dispatch gate admits (seq >= 256, d % 64 == 0)
+        # embed 256 / 4 heads -> head dim 64. At the default seq 512 the
+        # use_flash gate routes to XLA attention (the measured in-model
+        # winner there); --seq-len 1024+ dispatches the Pallas kernel
+        # (PERF.md round-3 crossover)
         model = transformer.build_lm(10000, embed_dim=256, num_heads=4,
                                      ffn_dim=1024, num_layers=4, max_len=t)
         data = jnp.asarray(rng.integers(1, 10001, (batch, t))
@@ -232,13 +241,30 @@ def worker_train(name, batch, steps, budget_s, precision="bf16",
     buffers = model.buffer_tree()
     opt_state = opt_method.init_state(params)
 
+    def forward(p, bufs, data):
+        p_c = policy.cast_params_for_compute(p)
+        out, new_buf = functional_apply(model, p_c, bufs, data,
+                                        training=True)
+        return out, cast_tree(new_buf, jnp.float32)
+
+    # BIGDL_TPU_BENCH_REMAT=conv|full: remat A/B lever ("conv" saves conv
+    # outputs + BN stats, recomputes the elementwise tail in the backward —
+    # the bandwidth lever for the BN-bound ResNet step; see PERF.md)
+    remat = os.environ.get("BIGDL_TPU_BENCH_REMAT", "")
+    if remat == "conv":
+        from bigdl_tpu.ops.remat import conv_remat_policy
+        forward = jax.checkpoint(forward, policy=conv_remat_policy())
+    elif remat == "full":
+        forward = jax.checkpoint(forward)
+    elif remat:
+        log(f"ignoring unknown BIGDL_TPU_BENCH_REMAT={remat!r} "
+            "(expected 'conv' or 'full')")
+
     def step_fn(params, buffers, opt_state, data, labels):
         def loss_fn(p):
-            p_c = policy.cast_params_for_compute(p)
-            out, new_buf = functional_apply(model, p_c, buffers, data,
-                                            training=True)
+            out, new_buf = forward(p, buffers, data)
             loss = criterion.apply(out, labels).astype(jnp.float32)
-            return loss, cast_tree(new_buf, jnp.float32)
+            return loss, new_buf
 
         grads, new_buf = jax.grad(loss_fn, has_aux=True)(params)
         new_params, new_opt = opt_method.update(grads, opt_state, params)
@@ -252,7 +278,11 @@ def worker_train(name, batch, steps, budget_s, precision="bf16",
     # On CPU fallbacks there is no RPC to amortize and steps are seconds
     # long — K=1 keeps the budget checks fine-grained so slow workers
     # emit partial numbers instead of dying at the timeout.
-    K = 5 if jax.default_backend() == "tpu" else 1
+    try:
+        K = max(1, int(os.environ.get("BIGDL_TPU_BENCH_K", "") or
+                       (5 if jax.default_backend() == "tpu" else 1)))
+    except ValueError:
+        K = 5 if jax.default_backend() == "tpu" else 1
 
     def multi_step(params, buffers, opt_state, data, labels):
         def body(_, st):
@@ -336,17 +366,18 @@ def run_worker(args):
 # --------------------------------------------------------------------------
 
 def _attempt(name, worker, batch, steps, budget_s, platform="",
-             precision="bf16", grace=90, extra_env=None):
+             precision="bf16", grace=90, seq_len=None):
     cmd = [sys.executable, os.path.abspath(__file__),
            "--worker", worker, "--batch", str(batch), "--steps", str(steps),
            "--budget", str(budget_s), "--precision", precision]
+    if seq_len:
+        cmd += ["--seq-len", str(seq_len)]
     if platform:
         cmd += ["--platform", platform]
     log(f"attempt {name}: {' '.join(cmd[2:])} (timeout {budget_s + grace}s)")
     try:
         proc = subprocess.run(
             cmd, stdout=subprocess.PIPE, stderr=sys.stderr,
-            env={**os.environ, **(extra_env or {})},
             timeout=budget_s + grace)  # interpreter/backend teardown margin
     except subprocess.TimeoutExpired:
         log(f"attempt {name}: KILLED on timeout")
@@ -416,7 +447,7 @@ _LADDERS = {
     "resnet50": [(256, 20, 540), (128, 20, 360), (32, 20, 300)],
     "vgg16": [(128, 20, 540), (32, 10, 300)],
     "inception_v1": [(256, 20, 540), (64, 10, 300)],
-    "lenet": [(512, 100, 180)],
+    "lenet": [(256, 100, 180)],  # b=512 wedges XLA compile on this libtpu
     "lstm": [(256, 20, 420), (64, 10, 300)],
     "transformer": [(32, 20, 420), (8, 10, 300)],
 }
@@ -455,7 +486,7 @@ def run_all(args):
             res = _attempt(name, worker, args.batch or batch,
                            args.steps or steps,
                            min(args.budget or budget, rem - 30), platform,
-                           args.precision)
+                           args.precision, seq_len=args.seq_len)
             if res is not None:
                 res["model"] = model
                 print(json.dumps(res), flush=True)
@@ -482,9 +513,13 @@ def main():
                     help="per-attempt wall budget (seconds)")
     ap.add_argument("--platform", default="",
                     help="force a jax platform (worker only)")
+    ap.add_argument("--seq-len", type=int, default=None,
+                    help="override sequence length for lstm/transformer "
+                    "(forwarded to workers in driver mode)")
     ap.add_argument("--worker", default=None, choices=_MODELS,
                     help="internal: run one attempt in this process")
     args = ap.parse_args()
+    _apply_seq_len_override(args)
 
     if args.worker:
         dflt_b, dflt_s, _ = _LADDERS[args.worker][0]
@@ -503,7 +538,7 @@ def main():
     else:
         # driver headline: resnet50 ladder, then lenet, then CPU fallback
         attempts = ([a for a in _model_attempts("resnet50") if a[5] != "cpu"]
-                    + [("lenet-b512", "lenet", 512, 100, 180, ""),
+                    + [("lenet-b256", "lenet", 256, 100, 180, ""),
                        ("lenet-cpu", "lenet", 512, 50, 180, "cpu")])
     # user overrides apply to EVERY attempt (fallback chain preserved)
     if args.batch:
@@ -552,28 +587,13 @@ def main():
             continue
         budget = min(budget, rem - grace)
         res = _attempt(name, worker, batch, steps, budget, platform,
-                       args.precision, grace=grace)
+                       args.precision, grace=grace, seq_len=args.seq_len)
         if res is not None:
-            # Self-A/B: with TPU budget left after a plain resnet50 win, run
-            # the fused conv+BN ladder once and report the better number —
-            # the round's driver-visible headline then captures the kernel
-            # win (or records the regression) without a second driver run.
-            if (worker == "resnet50" and platform != "cpu"
-                    and remaining() - cpu_reserve - grace > 300):
-                fused_env = {"BIGDL_TPU_FUSED_1X1": "1",
-                             "BIGDL_TPU_FUSED_3X3": "1"}
-                fused = _attempt(f"{name}-fused", worker, batch, steps,
-                                 min(budget, remaining() - cpu_reserve
-                                     - grace),
-                                 platform, args.precision, grace=grace,
-                                 extra_env=fused_env)
-                if fused is not None:
-                    if fused.get("value", 0) > res.get("value", 0):
-                        fused["fused_kernels"] = True
-                        fused["unfused_value"] = res.get("value")
-                        res = fused
-                    else:
-                        res["fused_ab_value"] = fused.get("value")
+            # The fused conv+BN self-A/B that lived here was answered on
+            # hardware in round 3: the Pallas fused path LOSES to XLA's
+            # native convs (2539 plain vs 1165/1854/1112 img/s for
+            # 1x1/3x3/both at b=256) — see PERF.md. The flags remain as
+            # manual levers only; spending driver budget re-asking is waste.
             print(json.dumps(res), flush=True)
             return
     # Every attempt failed: still emit a parseable line so the driver

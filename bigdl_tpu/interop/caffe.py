@@ -194,27 +194,29 @@ class CaffeLoader:
             if l.blobs or l.name not in layers:
                 layers[l.name] = l  # binary blobs win over text definition
         copied, missed = [], []
+        weighted = (nn.Linear, nn.SpatialConvolution, nn.SpaceToDepthConv7)
         for name, module in self.model.named_modules():
             lname = module.get_name()
             layer = layers.get(lname)
             if layer is None:
-                if isinstance(module, (nn.Linear, nn.SpatialConvolution)):
+                if isinstance(module, weighted):
                     missed.append(lname)
                 continue
             if not layer.blobs:
                 if lname in def_names:
                     # declared in the definition but weightless — reference
                     # keeps initialized parameters (CaffeLoader.scala:150-155)
-                    if isinstance(module, (nn.Linear, nn.SpatialConvolution)):
+                    if isinstance(module, weighted):
                         logger.info("%s uses initialized parameters", lname)
                 else:
                     # a blobless layer in the binary itself is a missing
                     # weight (truncated/deploy-only caffemodel), not a
                     # benign definition entry
-                    if isinstance(module, (nn.Linear, nn.SpatialConvolution)):
+                    if isinstance(module, weighted):
                         missed.append(lname)
                 continue
-            if isinstance(module, nn.SpatialConvolution):
+            if isinstance(module, (nn.SpatialConvolution,
+                                   nn.SpaceToDepthConv7)):
                 self._copy_conv(module, layer)
             elif isinstance(module, nn.Linear):
                 self._copy_linear(module, layer)

@@ -437,7 +437,8 @@ def to_torch_object(m) -> TorchObject:
     }
     if isinstance(m, nn.Linear):
         return _linear_to_torch(m)
-    if isinstance(m, nn.SpatialConvolution):
+    if isinstance(m, (nn.SpatialConvolution, nn.SpaceToDepthConv7)):
+        # the space-to-depth stem IS a 7x7/s2 conv: export as one
         return _conv_to_torch(m)
     if isinstance(m, nn.SpatialBatchNormalization):
         return _bn_to_torch(m, "nn.SpatialBatchNormalization")

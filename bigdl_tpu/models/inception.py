@@ -38,8 +38,8 @@ def build(class_num: int = 1000) -> nn.Sequential:
     """Inception v1 main tower (no aux classifiers, like the reference's
     ``Inception_v1_NoAuxClassifier``); input (N, 224, 224, 3)."""
     model = (nn.Sequential()
-             .add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
-                                        init_method="xavier").set_name("conv1/7x7_s2"))
+             .add(nn.stem_conv7(3, 64, init_method="xavier",
+                                name="conv1/7x7_s2"))
              .add(nn.ReLU(True))
              .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
              .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
@@ -87,6 +87,14 @@ def _conv_bn(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
                 .add(FusedConv3x3BN(n_in, n_out, eps=1e-3,
                                     init_method="xavier",
                                     with_bias=True).set_name(name))
+                .add(nn.ReLU(True)))
+    if (kw, kh, sw, sh, pw, ph) == (7, 7, 2, 2, 3, 3):
+        # ImageNet stem: space-to-depth form (PERF.md round 3)
+        return (nn.Sequential()
+                .add(nn.stem_conv7(n_in, n_out, init_method="xavier",
+                                   name=name))
+                .add(nn.SpatialBatchNormalization(n_out, 1e-3)
+                     .set_name(name + "/bn"))
                 .add(nn.ReLU(True)))
     return (nn.Sequential()
             .add(nn.SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph,

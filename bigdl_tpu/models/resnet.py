@@ -26,6 +26,8 @@ def _conv(n_in, n_out, k, stride=1, pad=0):
                                  with_bias=False, init_method="kaiming")
 
 
+
+
 def _use_fused_1x1() -> bool:
     from bigdl_tpu.nn.fused import use_fused_1x1
     return use_fused_1x1()
@@ -90,8 +92,8 @@ def build(class_num: int = 1000, depth: int = 50,
     assert depth in _IMAGENET_CFG, f"unsupported depth {depth}"
     layers, block_kind = _IMAGENET_CFG[depth]
     model = (nn.Sequential()
-             .add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
-                                        with_bias=False, init_method="kaiming"))
+             .add(nn.stem_conv7(3, 64, with_bias=False,
+                                init_method="kaiming"))
              .add(nn.SpatialBatchNormalization(64))
              .add(nn.ReLU())
              .add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)))

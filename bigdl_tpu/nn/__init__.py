@@ -32,7 +32,8 @@ from bigdl_tpu.nn.linear import (
     Add, CAdd, Mul, CMul, Scale,
 )
 from bigdl_tpu.nn.conv import (
-    SpatialConvolution, SpatialShareConvolution, SpatialDilatedConvolution,
+    SpatialConvolution, SpatialShareConvolution, SpaceToDepthConv7,
+    stem_conv7, SpatialDilatedConvolution,
     SpatialFullConvolution, VolumetricConvolution, SpatialConvolutionMap,
 )
 from bigdl_tpu.nn.pooling import (

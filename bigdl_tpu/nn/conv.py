@@ -20,6 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 
 from bigdl_tpu.nn import initialization as init
 from bigdl_tpu.nn.module import TensorModule
@@ -82,6 +83,12 @@ class SpatialConvolution(TensorModule):
             feature_group_count=self.n_group)
         if self.with_bias:
             out = out + self.bias
+        # Tag for remat policies (``set_remat("conv")``): save conv outputs,
+        # recompute the cheap elementwise tail (BN normalize, ReLU) in the
+        # backward instead of materializing those copies to HBM. A no-op
+        # unless the training loop wraps the forward in jax.checkpoint with
+        # a name-based policy.
+        out = checkpoint_name(out, "conv_out")
         return out[0] if squeeze else out
 
     def __repr__(self):
@@ -94,6 +101,107 @@ class SpatialShareConvolution(SpatialConvolution):
     """reference ``nn/SpatialShareConvolution.scala`` shares im2col buffers
     across replicas to cut memory; under XLA there are no such buffers, so
     this is exactly SpatialConvolution."""
+
+
+def stem_conv7(n_in: int, n_out: int, with_bias: bool = True,
+               init_method: str = "default", name: str = ""):
+    """Factory for the 7x7/s2/p3 ImageNet stem: SpaceToDepthConv7 (the
+    measured-faster packed form) unless ``BIGDL_TPU_NO_S2D=1`` restores the
+    plain SpatialConvolution. Both share one parameter schema
+    ("weight" (7,7,C,O) [+ "bias"]), so checkpoints interchange."""
+    import os
+    if os.environ.get("BIGDL_TPU_NO_S2D"):
+        mod = SpatialConvolution(n_in, n_out, 7, 7, 2, 2, 3, 3,
+                                 with_bias=with_bias,
+                                 init_method=init_method)
+    else:
+        mod = SpaceToDepthConv7(n_in, n_out, with_bias=with_bias,
+                                init_method=init_method)
+    return mod.set_name(name) if name else mod
+
+
+class SpaceToDepthConv7(TensorModule):
+    """The 7x7/stride-2/pad-3 stem conv computed via 2x2 space-to-depth —
+    numerically identical, ~4x better MXU utilisation (the MLPerf ResNet
+    trick, here as a drop-in module).
+
+    A (H, W, 3) input drives the MXU at 3/128 lane occupancy; packing 2x2
+    pixels into the channel dim gives a (H/2, W/2, 12) input and turns the
+    7x7/s2 conv into a 4x4/s1 conv at 4x the input channels. The parameter
+    stays the reference-shaped ``(7, 7, C, O)`` tensor ("weight", kaiming —
+    checkpoint-compatible with SpatialConvolution); the forward scatters it
+    into the packed ``(4, 4, 4C, O)`` layout (pad 7x7 -> 8x8 at offset 1,
+    regroup) — a 9 KB transform, so the function class is EXACTLY the
+    reference stem, not a freely-trained 8x8 conv.
+
+    Derivation: out(i,j) = sum_{r,s} w7[r,s] x[2i-3+r, 2j-3+s]. With packed
+    blocks xp[I] = x[2I:2I+2], a 4-block window starting at I = i-2 covers
+    pixels 2i-4 .. 2i+3; embedding w7 at offset 1 in an 8x8 w8 aligns
+    w8[kh] with pixel 2i-4+kh = 2i-3+r. Packed padding (2, 1) per side
+    reproduces pixel padding (3, 2) (pixel pad 3 lo + the odd window end).
+    """
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 with_bias: bool = True, init_method: str = "default",
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.with_bias = with_bias
+        # full SpatialConvolution attribute surface so interop (.t7 export,
+        # Caffe import) treats this as the 7x7/s2/p3 conv it is
+        self.kernel_h = self.kernel_w = 7
+        self.stride_h = self.stride_w = 2
+        self.pad_h = self.pad_w = 3
+        self.n_group = 1
+        fan_in = 7 * 7 * n_input_plane
+        fan_out = 7 * 7 * n_output_plane
+        w = init.conv_weight(init_method, (7, 7, n_input_plane,
+                                           n_output_plane), fan_in, fan_out)
+        self.register_parameter("weight", w, regularizer=w_regularizer)
+        if with_bias:
+            self.register_parameter(
+                "bias", init.default_init((n_output_plane,), fan_in),
+                regularizer=b_regularizer)
+
+    def update_output(self, input):
+        squeeze = input.ndim == 3
+        if squeeze:
+            input = input[None]
+        x = match_compute(input, self.weight)
+        if x.shape[-1] != self.n_input_plane:
+            raise ValueError(f"SpaceToDepthConv7({self.n_input_plane}) got "
+                             f"input {x.shape}")
+        # Odd spatial dims: extend with one zero row/col. Exactly equivalent
+        # — the appended zeros occupy positions the plain conv's own hi-side
+        # padding covered, and the packed output count (H+1)/2 matches the
+        # plain conv's (H-1)//2 + 1.
+        pad_h, pad_w = x.shape[1] % 2, x.shape[2] % 2
+        if pad_h or pad_w:
+            x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+        n, h, w, c = x.shape
+        o = self.n_output_plane
+        # pack 2x2 spatial blocks into channels, order (di, dj, c)
+        xp = (x.reshape(n, h // 2, 2, w // 2, 2, c)
+              .transpose(0, 1, 3, 2, 4, 5)
+              .reshape(n, h // 2, w // 2, 4 * c))
+        # scatter the 7x7 weight into the packed 4x4 layout (same order)
+        w8 = jnp.pad(self.weight.astype(x.dtype),
+                     ((1, 0), (1, 0), (0, 0), (0, 0)))
+        w4 = (w8.reshape(4, 2, 4, 2, c, o)
+              .transpose(0, 2, 1, 3, 4, 5)
+              .reshape(4, 4, 4 * c, o))
+        out = jax.lax.conv_general_dilated(
+            xp, w4, window_strides=(1, 1), padding=((2, 1), (2, 1)),
+            dimension_numbers=_DN_2D)
+        if self.with_bias:
+            out = out + self.bias
+        out = checkpoint_name(out, "conv_out")
+        return out[0] if squeeze else out
+
+    def __repr__(self):
+        return (f"SpaceToDepthConv7({self.n_input_plane} -> "
+                f"{self.n_output_plane}, 7x7, 2,2, 3,3, space-to-depth)")
 
 
 class SpatialDilatedConvolution(TensorModule):

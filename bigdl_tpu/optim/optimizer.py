@@ -78,7 +78,13 @@ def make_training_loss_fn(model, criterion, policy, reg_pairs, remat,
                                         training=True, rng=rng)
         return out, cast_tree(new_buf, jnp.float32)
 
-    fwd = jax.checkpoint(forward) if remat else forward
+    if remat == "conv":
+        from bigdl_tpu.ops.remat import conv_remat_policy
+        fwd = jax.checkpoint(forward, policy=conv_remat_policy())
+    elif remat:
+        fwd = jax.checkpoint(forward)
+    else:
+        fwd = forward
 
     def loss_fn(p):
         out, new_buf = fwd(p, data)
@@ -193,12 +199,29 @@ class Optimizer:
         self.end_when = end_when
         return self
 
-    def set_remat(self, enabled: bool = True) -> "Optimizer":
-        """Rematerialize the forward in the backward pass (``jax.checkpoint``):
-        activation memory drops to O(1) forwards at ~1.3x FLOPs — the
-        standard TPU recipe when a model does not fit HBM. Off by default
-        (compute-bound models should keep their activations)."""
-        self._remat = bool(enabled)
+    def set_remat(self, enabled=True) -> "Optimizer":
+        """Rematerialize the forward in the backward pass (``jax.checkpoint``).
+
+        ``True``: full remat — activation memory drops to O(1) forwards at
+        ~1.3x FLOPs, the standard TPU recipe when a model does not fit HBM.
+
+        ``"conv"``: name-based policy for bandwidth-bound conv/BN models —
+        SAVE conv outputs and BN statistics (tagged via ``checkpoint_name``
+        in ``nn/conv.py`` / ``ops/batch_norm.py``), recompute the cheap
+        elementwise tail (BN normalize, ReLU) in the backward instead of
+        materializing those activation copies to HBM.
+
+        Off by default (compute-bound models should keep activations)."""
+        if isinstance(enabled, str):
+            if enabled == "full":  # alias for True (matches the bench lever)
+                self._remat = True
+            elif enabled == "conv":
+                self._remat = enabled
+            else:
+                raise ValueError(f"unknown remat policy {enabled!r}; "
+                                 "expected True/False, 'full' or 'conv'")
+        else:
+            self._remat = bool(enabled)
         return self
 
     def set_precision(self, policy) -> "Optimizer":

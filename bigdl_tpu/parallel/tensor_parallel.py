@@ -104,8 +104,10 @@ def _module_specs(module, axis: str) -> Dict[str, P]:
                 "out_proj_weight": P(None, axis), "out_proj_bias": P()}
     if isinstance(module, nn.LookupTable):
         return {"weight": P(None, axis)}
-    if isinstance(module, (nn.SpatialConvolution, nn.SpatialShareConvolution)):
-        # HWIO weight layout: shard output channels.
+    if isinstance(module, (nn.SpatialConvolution, nn.SpatialShareConvolution,
+                           nn.SpaceToDepthConv7)):
+        # HWIO weight layout: shard output channels (SpaceToDepthConv7
+        # stores the same (7,7,C,O) weight as the plain stem it replaces).
         return {"weight": P(None, None, None, axis), "bias": P(axis)}
     return {}
 
