@@ -6,6 +6,7 @@ from bigdl_tpu.dataset.base import (
     MTTransformer,
     AbstractDataSet, LocalDataSet, DistributedDataSet, DataSet,
 )
+from bigdl_tpu.dataset.device_cache import DeviceCachedDataSet
 from bigdl_tpu.dataset import image
 from bigdl_tpu.dataset import text
 from bigdl_tpu.dataset import mnist

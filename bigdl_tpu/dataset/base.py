@@ -69,6 +69,11 @@ class ByteRecord:
 class Transformer(Generic[A, B]):
     """Iterator→iterator stage (reference ``dataset/Transformer.scala:41``)."""
 
+    #: marks per-record randomness (random crop/flip/jitter): such stages
+    #: must not sit below a DeviceCachedDataSet (they would be frozen at
+    #: materialization — the cache scans for this flag)
+    stochastic = False
+
     #: True for stages whose output depends on MORE than one input record
     #: (batching/collation). Such stages cannot be fanned out per-record by
     #: MTTransformer.
@@ -295,6 +300,11 @@ class MTTransformer(Transformer[A, B]):
 
     def __init__(self, inner: Transformer[A, B], workers: int = 4,
                  window: Optional[int] = None):
+        # a chained inner (e.g. crop >> flip >> normalize) is stochastic if
+        # ANY stage is — the flat inner attribute alone would hide it from
+        # DeviceCachedDataSet's freeze guard
+        self.stochastic = any(getattr(s, "stochastic", False)
+                              for s in _flatten_chain(inner))
         for stage in _flatten_chain(inner):
             if stage.aggregating:
                 raise ValueError(

@@ -1,0 +1,183 @@
+"""Device-resident dataset cache — the TPU-native ``CachedDistriDataSet``.
+
+The reference caches each partition's samples in executor memory once and
+re-shuffles only an index array per epoch (``dataset/DataSet.scala:240,
+292-299``: "shuffle = reshuffle indexes only"); batches are then collated
+from the cached samples. The TPU-native descendant goes one step further:
+the whole (deterministically transformed) dataset lives ON DEVICE as one
+stacked feature/label array pair, each epoch draws a fresh SAMPLE-level
+permutation (same composition semantics as the reference — batch membership
+changes every epoch), and batches are produced by on-device gathers.
+
+Why it exists (PERF.md round 3): the real training loop was host-transfer
+bound — every iteration re-stacked ~154 MB on the host and pushed it
+through a ~68 MB/s tunneled H2D path (2.2 s/batch for a 0.1 s step). With
+the cache, the transfer happens once and an epoch costs one (N,)-int
+permutation upload plus device gathers.
+
+Limits, by design:
+- the wrapped dataset must be finite and fit device memory next to the
+  model (a (N, 224, 224, 3) f32 cache is N x 602 KB);
+- RANDOM host augmentations (random crop/flip/jitter) must NOT sit below
+  the cache — they would be frozen at materialization. Enforced: stages
+  marked ``stochastic`` in the wrapped chain raise at materialization.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.base import AbstractDataSet, MiniBatch, Sample
+from bigdl_tpu.utils.rng import RandomGenerator
+
+
+class CachedSliceBatch:
+    """Lazy MiniBatch: indices into the device cache, gathered on access.
+
+    ``data``/``labels`` are properties so the single-dispatch path is
+    transparent (``jnp.asarray(batch.data)`` triggers the gather), while the
+    K-fused dispatch path (``set_steps_per_dispatch``) reads ``.idx`` and
+    performs the gathers INSIDE the jitted multi-step — one dispatch per
+    window instead of one per gather (each device dispatch costs ~15 ms RPC
+    on the tunneled backend; PERF.md round 3)."""
+
+    __slots__ = ("source", "idx")
+
+    def __init__(self, source: "DeviceCachedDataSet", idx):
+        self.source = source
+        self.idx = idx
+
+    @property
+    def data(self):
+        return self.source._x[self.idx]
+
+    @property
+    def labels(self):
+        return self.source._y[self.idx]
+
+    def size(self) -> int:
+        return int(self.idx.shape[0])
+
+    def __iter__(self):
+        yield self.data
+        yield self.labels
+
+
+class DeviceCachedDataSet(AbstractDataSet[MiniBatch]):
+    """Materialize a Sample-level dataset on device once; serve shuffled
+    MiniBatches via on-device gathers.
+
+    >>> import numpy as np
+    >>> from bigdl_tpu.dataset.base import DataSet, Sample
+    >>> ds = DeviceCachedDataSet(DataSet.array(
+    ...     [Sample(np.full((2,), i, np.float32), float(i % 2 + 1))
+    ...      for i in range(8)]), batch_size=4)
+    >>> batches = list(ds.data(train=False))
+    >>> [int(b.size()) for b in batches]
+    [4, 4]
+    """
+
+    def __init__(self, base: AbstractDataSet[Sample], batch_size: int,
+                 cast_dtype: Optional[str] = None):
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self.base = base
+        self.batch_size = batch_size
+        # transfer dtype for features (e.g. "bfloat16" halves H2D bytes AND
+        # cache footprint when the compute policy is bf16 anyway)
+        self.cast_dtype = cast_dtype
+        self._x = None
+        self._y = None
+        self._perm = None
+
+    # ------------------------------------------------------------------ cache
+    def _scan_for_stochastic_stages(self) -> None:
+        """Refuse to freeze random augmentation: a stochastic stage (random
+        crop/flip/jitter) below the cache would be drawn ONCE and re-served
+        every epoch — silent model-quality damage, so it is an error."""
+        from bigdl_tpu.dataset.base import (TransformedDataSet,
+                                            _flatten_chain)
+        ds = self.base
+        while isinstance(ds, TransformedDataSet):
+            for stage in _flatten_chain(ds.transformer):
+                if getattr(stage, "stochastic", False):
+                    raise ValueError(
+                        f"DeviceCachedDataSet cannot cache below the "
+                        f"stochastic stage {type(stage).__name__}: its "
+                        "random draw would be frozen at materialization. "
+                        "Keep random augmentation out of the cached chain "
+                        "(or use the host collate path).")
+            ds = ds.base
+
+    def _materialize(self) -> None:
+        if self._x is not None:
+            return
+        self._scan_for_stochastic_stages()
+        import jax.numpy as jnp
+        feats, labels = [], []
+        for s in self.base.data(train=False):
+            # Sample has .feature; the image types (LabeledImage) carry the
+            # array as .data with the same (feature, label) meaning
+            feats.append(s.feature if hasattr(s, "feature") else s.data)
+            labels.append(s.label)
+        if not feats:
+            raise ValueError("DeviceCachedDataSet: wrapped dataset is empty")
+        if len(feats) < self.batch_size:
+            raise ValueError(
+                f"DeviceCachedDataSet: {len(feats)} samples cannot fill one "
+                f"batch of {self.batch_size}")
+        x = np.stack(feats)
+        if self.cast_dtype:
+            import ml_dtypes  # noqa: F401 - registers bfloat16 with numpy
+            x = x.astype(self.cast_dtype)
+        self._x = jnp.asarray(x)
+        y = np.stack([np.asarray(l) for l in labels])
+        if y.ndim == 2 and y.shape[1] == 1:
+            y = y[:, 0]  # SampleToBatch's (N,1)->(N,) label squeeze parity
+        self._y = jnp.asarray(y)
+
+    # --------------------------------------------------------------- protocol
+    def data(self, train: bool) -> Iterator[MiniBatch]:
+        self._materialize()
+        import jax.numpy as jnp
+        n = int(self._x.shape[0])
+        n_batches = n // self.batch_size  # static shapes: drop remainder
+        if train:
+            if self._perm is None:
+                self.shuffle()
+            perm = self._perm
+            self._perm = None  # one permutation per epoch
+            idx_dev = jnp.asarray(perm)  # one tiny (N,) int32 upload/epoch
+            for b in range(n_batches):
+                sl = idx_dev[b * self.batch_size:(b + 1) * self.batch_size]
+                yield CachedSliceBatch(self, sl)
+        else:
+            for b in range(n_batches):
+                lo, hi = b * self.batch_size, (b + 1) * self.batch_size
+                yield MiniBatch(self._x[lo:hi], self._y[lo:hi])
+
+    def size(self) -> int:
+        if self._x is not None:
+            return int(self._x.shape[0])
+        return self.base.size()
+
+    def shuffle(self) -> None:
+        # materialize first: the wrapped chain may change record cardinality
+        # (1:0/1:n stages), and a permutation sized from base.size() would
+        # silently clamp or truncate gathers
+        self._materialize()
+        n = int(self._x.shape[0])
+        # randperm is 1-based (Torch semantics); indices here are 0-based
+        self._perm = np.asarray(RandomGenerator.RNG().randperm(n) - 1,
+                                np.int32)
+
+    def is_distributed(self) -> bool:
+        return False
+
+    def transform(self, transformer):
+        raise TypeError(
+            "DeviceCachedDataSet is terminal: apply transformers to the "
+            "wrapped dataset BEFORE caching (random host augmentations "
+            "would be frozen at materialization)")

@@ -101,6 +101,7 @@ class BGRImgCropper(Transformer[LabeledImage, LabeledImage]):
 
     def __init__(self, crop_width: int, crop_height: int, random: bool = True):
         self.cw, self.ch, self.random = crop_width, crop_height, random
+        self.stochastic = random
 
     def __call__(self, prev: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
         rng = RandomGenerator.RNG()
@@ -137,6 +138,8 @@ class BGRImgRdmCropper(BGRImgCropper):
 class HFlip(Transformer[LabeledImage, LabeledImage]):
     """Random horizontal flip (reference ``HFlip``)."""
 
+    stochastic = True
+
     def __init__(self, threshold: float = 0.5):
         self.threshold = threshold
 
@@ -151,6 +154,8 @@ class HFlip(Transformer[LabeledImage, LabeledImage]):
 
 class ColorJitter(Transformer[LabeledImage, LabeledImage]):
     """Random brightness/contrast/saturation (reference ``ColorJitter``)."""
+
+    stochastic = True
 
     def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
                  saturation: float = 0.4):
@@ -179,6 +184,8 @@ class ColorJitter(Transformer[LabeledImage, LabeledImage]):
 
 class Lighting(Transformer[LabeledImage, LabeledImage]):
     """AlexNet PCA-noise lighting (reference ``Lighting``)."""
+
+    stochastic = True
 
     EIGVAL = np.asarray([0.2175, 0.0188, 0.0045], np.float32)
     EIGVEC = np.asarray([[-0.5675, 0.7192, 0.4009],

@@ -1,0 +1,185 @@
+"""DeviceCachedDataSet: on-device dataset cache (PERF.md round 3).
+
+Semantics under test: sample-level reshuffle per epoch (reference
+CachedDistriDataSet's "shuffle = reshuffle indexes only",
+``DataSet.scala:292-299``), exact batch contents vs the host path, one
+materialization, terminal-stage contract, and end-to-end training parity.
+"""
+
+import numpy as np
+import pytest
+
+import bigdl_tpu as bt
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DeviceCachedDataSet, Sample, SampleToBatch
+from bigdl_tpu.dataset.base import DataSet
+from bigdl_tpu.models import lenet
+from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+
+def _samples(n, shape=(4,), classes=2):
+    rng = np.random.default_rng(0)
+    return [Sample(rng.normal(0, 1, shape).astype(np.float32),
+                   float(rng.integers(1, classes + 1))) for i in range(n)]
+
+
+def test_eval_batches_match_host_path():
+    samples = _samples(10)
+    cached = DeviceCachedDataSet(DataSet.array(samples), batch_size=4)
+    host = DataSet.array(samples) >> SampleToBatch(4)
+    a = list(cached.data(train=False))
+    b = list(host.data(train=False))
+    assert len(a) == len(b) == 2  # drop-remainder parity
+    for ca, cb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(ca.data), cb.data)
+        np.testing.assert_array_equal(np.asarray(ca.labels), cb.labels)
+
+
+def test_train_epoch_is_sample_level_permutation():
+    samples = _samples(8, shape=(1,))
+    ds = DeviceCachedDataSet(DataSet.array(samples), batch_size=4)
+    bt.utils.manual_seed(7)
+    epoch1 = np.concatenate([np.asarray(b.data).ravel()
+                             for b in ds.data(train=True)])
+    epoch2 = np.concatenate([np.asarray(b.data).ravel()
+                             for b in ds.data(train=True)])
+    all_feats = np.concatenate([s.feature for s in samples])
+    # every sample appears exactly once per epoch...
+    np.testing.assert_allclose(np.sort(epoch1), np.sort(all_feats), rtol=1e-6)
+    # ...and batch composition changes between epochs (sample-level shuffle)
+    assert not np.array_equal(epoch1, epoch2)
+
+
+def test_materializes_once_and_serves_many_epochs():
+    calls = {"n": 0}
+
+    class CountingDataSet(DataSet.array(_samples(8)).__class__):
+        def data(self, train):
+            calls["n"] += 1
+            return super().data(train)
+
+    base = CountingDataSet(_samples(8))
+    ds = DeviceCachedDataSet(base, batch_size=4)
+    for _ in range(3):
+        list(ds.data(train=True))
+    assert calls["n"] == 1, "base dataset must be read exactly once"
+
+
+def test_terminal_stage_and_validation():
+    ds = DeviceCachedDataSet(DataSet.array(_samples(8)), batch_size=4)
+    with pytest.raises(TypeError):
+        ds.transform(SampleToBatch(2))
+    with pytest.raises(ValueError):
+        list(DeviceCachedDataSet(DataSet.array(_samples(2)),
+                                 batch_size=4).data(train=False))
+    with pytest.raises(ValueError):
+        DeviceCachedDataSet(DataSet.array(_samples(4)), batch_size=0)
+
+
+def test_caches_image_pipeline_types():
+    # the image transformers yield LabeledImage (array under .data, not
+    # .feature) — the cache must accept the standard MNIST chain (caught on
+    # the real chip by the round-3 verify drive)
+    from bigdl_tpu.dataset import mnist
+    from bigdl_tpu.dataset.image import BytesToGreyImg, GreyImgNormalizer
+    raw = (DataSet.array(mnist.synthetic(16)) >> BytesToGreyImg(28, 28)
+           >> GreyImgNormalizer(33., 78.))
+    ds = DeviceCachedDataSet(raw, batch_size=8)
+    batches = list(ds.data(train=False))
+    assert [b.size() for b in batches] == [8, 8]
+    assert batches[0].data.shape == (8, 28, 28, 1)
+
+
+def test_rejects_stochastic_stage_below_cache():
+    # freezing a random augmentation at materialization is silent model
+    # damage -> hard error (the stochastic flag on Transformer)
+    from bigdl_tpu.dataset import mnist
+    from bigdl_tpu.dataset.image import BytesToGreyImg, HFlip
+    raw = DataSet.array(mnist.synthetic(16)) >> BytesToGreyImg(28, 28) \
+        >> HFlip(0.5)
+    with pytest.raises(ValueError, match="stochastic"):
+        list(DeviceCachedDataSet(raw, batch_size=8).data(train=False))
+
+
+def test_shape1_labels_squeezed_like_host_path():
+    # SampleToBatch squeezes (N,1) labels to (N,); the cache must match or
+    # ClassNLLCriterion breaks on previously-working datasets
+    samples = [Sample(np.ones((4,), np.float32), np.asarray([float(i % 2 + 1)]))
+               for i in range(8)]
+    cached = next(DeviceCachedDataSet(DataSet.array(samples), batch_size=8)
+                  .data(train=False))
+    host = next((DataSet.array(samples) >> SampleToBatch(8))
+                .data(train=False))
+    assert cached.labels.shape == host.labels.shape == (8,)
+
+
+def test_cast_dtype_halves_cache():
+    import jax.numpy as jnp
+    ds = DeviceCachedDataSet(DataSet.array(_samples(8)), batch_size=4,
+                             cast_dtype="bfloat16")
+    batch = next(ds.data(train=False))
+    assert batch.data.dtype == jnp.bfloat16
+
+
+def test_training_through_device_cache_matches_host_path(monkeypatch):
+    # Same seed, same model init, same batches -> identical trained params
+    # whether batches come from the device cache or the host collate path.
+    # Shuffles are pinned to identity (the two paths draw from the RNG
+    # differently; sample-level shuffle semantics are asserted above) so
+    # any divergence here is a COMPUTE-path difference.
+    from bigdl_tpu.dataset.base import LocalDataSet
+    monkeypatch.setattr(LocalDataSet, "shuffle", lambda self: None)
+    monkeypatch.setattr(
+        DeviceCachedDataSet, "shuffle",
+        lambda self: setattr(self, "_perm",
+                             np.arange(self.size(), dtype=np.int32)))
+
+    def run(cached):
+        bt.utils.manual_seed(11)
+        rng = np.random.default_rng(3)
+        samples = [Sample(rng.normal(0, 1, (28, 28, 1)).astype(np.float32),
+                          float(rng.integers(1, 11))) for _ in range(64)]
+        if cached:
+            ds = DeviceCachedDataSet(DataSet.array(samples), batch_size=32)
+        else:
+            ds = DataSet.array(samples) >> SampleToBatch(32)
+        model = lenet.build(10)
+        opt = Optimizer(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_iteration(4))
+        trained = opt.optimize()
+        import jax
+        return [np.asarray(x) for x in
+                jax.tree_util.tree_leaves(trained.parameter_tree())]
+
+    for a, b in zip(run(False), run(True)):
+        np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)
+
+
+def test_k_fused_dispatch_over_cache_matches_k1(monkeypatch):
+    # device cache + set_steps_per_dispatch: the in-jit gather path must
+    # train identically to single-step dispatch over the same cache
+    from bigdl_tpu.dataset.base import LocalDataSet
+    monkeypatch.setattr(
+        DeviceCachedDataSet, "shuffle",
+        lambda self: setattr(self, "_perm",
+                             np.arange(self.size(), dtype=np.int32)))
+
+    def run(k):
+        bt.utils.manual_seed(13)
+        rng = np.random.default_rng(5)
+        samples = [Sample(rng.normal(0, 1, (28, 28, 1)).astype(np.float32),
+                          float(rng.integers(1, 11))) for _ in range(128)]
+        ds = DeviceCachedDataSet(DataSet.array(samples), batch_size=32)
+        model = lenet.build(10)
+        opt = Optimizer(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1)) \
+           .set_end_when(Trigger.max_iteration(6)) \
+           .set_steps_per_dispatch(k)
+        trained = opt.optimize()
+        import jax
+        return [np.asarray(x) for x in
+                jax.tree_util.tree_leaves(trained.parameter_tree())]
+
+    for a, b in zip(run(1), run(4)):
+        np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)

@@ -305,3 +305,111 @@ def test_transformer_tp_with_sequence_parallel_regions_trains():
     import jax
     for leaf in jax.tree_util.tree_leaves(trained.parameter_tree()):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+class TestStepsPerDispatch:
+    """set_steps_per_dispatch: K-fused dispatch (PERF.md round 3) must be a
+    pure scheduling change — identical numerics, exact per-iteration logs,
+    trigger-bounded windows."""
+
+    def _run(self, k, iters=6, trigger=None, checkpoint_dir=None):
+        bt.utils.manual_seed(31)
+        model = lenet.build(10)
+        opt = Optimizer(model, make_dataset(512, 64), nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.05, momentum=0.9)) \
+           .set_end_when(Trigger.max_iteration(iters)) \
+           .set_steps_per_dispatch(k)
+        if trigger is not None:
+            opt.set_validation(trigger, make_dataset(128, 64),
+                               [Top1Accuracy()])
+        if checkpoint_dir is not None:
+            opt.set_checkpoint(checkpoint_dir,
+                               Trigger.several_iteration(2))
+        losses = []
+
+        class Sink:
+            def add_scalar(self, tag, value, step):
+                if tag == "Loss":
+                    losses.append((step, float(value)))
+
+            def get_summary_trigger(self, name):
+                return None
+
+        opt.set_train_summary(Sink())
+        trained = opt.optimize()
+        import jax
+        leaves = [np.asarray(x) for x in
+                  jax.tree_util.tree_leaves(trained.parameter_tree())]
+        return leaves, losses
+
+    def test_numerics_and_logs_match_k1(self):
+        p1, l1 = self._run(1)
+        p4, l4 = self._run(4)
+        assert [s for s, _ in l1] == list(range(1, 7))  # every iter logged
+        assert [s for s, _ in l4] == [s for s, _ in l1]  # exact per-iter logs
+        for (s1, a), (s4, b) in zip(l1, l4):
+            assert abs(a - b) < 1e-5, (s1, a, b)
+        for a, b in zip(p1, p4):
+            np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)
+
+    def test_respects_max_iteration_exactly(self):
+        _, losses = self._run(4, iters=5)
+        assert [s for s, _ in losses] == [1, 2, 3, 4, 5]
+
+    def test_checkpoints_match_k1(self, tmp_path):
+        d1, d4 = tmp_path / "k1", tmp_path / "k4"
+        d1.mkdir(), d4.mkdir()
+        self._run(1, iters=6, checkpoint_dir=str(d1))
+        self._run(4, iters=6, checkpoint_dir=str(d4))
+        from bigdl_tpu.utils import file_io
+        names = sorted(p.name for p in d1.iterdir())
+        assert names == sorted(p.name for p in d4.iterdir())
+        assert any(n.startswith("model") for n in names)
+        import jax
+        for n in names:
+            if not n.startswith("model"):
+                continue
+            a = file_io.load(str(d1 / n))["params"]
+            b = file_io.load(str(d4 / n))["params"]
+            for la, lb in zip(jax.tree_util.tree_leaves(a),
+                              jax.tree_util.tree_leaves(b)):
+                np.testing.assert_allclose(np.asarray(lb), np.asarray(la),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_validation_windows_bounded(self):
+        # validation every 2 iterations with K=4: windows must shrink so
+        # validation always runs against the params of the iteration it
+        # follows -> same validation COUNT as K=1 and identical numerics
+        p1, _ = self._run(1, iters=6, trigger=Trigger.several_iteration(2))
+        p4, _ = self._run(4, iters=6, trigger=Trigger.several_iteration(2))
+        for a, b in zip(p1, p4):
+            np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)
+
+    def test_rejects_bad_k(self):
+        opt = Optimizer(lenet.build(10), make_dataset(128, 64),
+                        nn.ClassNLLCriterion())
+        with pytest.raises(ValueError):
+            opt.set_steps_per_dispatch(0)
+
+    def test_custom_stateful_trigger_forces_windows_of_1(self):
+        # Trigger(fn) defaults to probe_safe=False: the window-bounding
+        # probe would corrupt a stateful predicate, so its presence must
+        # collapse windows to 1 — the trigger then sees exactly one real
+        # evaluation per iteration, in order.
+        from bigdl_tpu.optim.triggers import Trigger as Trig
+        seen = []
+
+        def fn(state):
+            seen.append(int(state["neval"]))
+            return False
+
+        bt.utils.manual_seed(33)
+        opt = Optimizer(lenet.build(10), make_dataset(512, 64),
+                        nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.05)) \
+           .set_end_when(Trigger.max_iteration(5)) \
+           .set_steps_per_dispatch(4)
+        opt.set_validation(Trig(fn), make_dataset(64, 64), [Top1Accuracy()])
+        opt.optimize()
+        per_iter = [n for n in seen]
+        assert per_iter[:5] == [2, 3, 4, 5, 6], per_iter
