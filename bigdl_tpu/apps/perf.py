@@ -105,21 +105,26 @@ def main(argv=None) -> None:
     else:
         samples = [Sample(f, np.float32(rng.randint(1, n_class + 1)))
                    for f in feats]
-    if args.distributed or args.no_device_cache:
-        if args.distributed and not args.no_device_cache:
-            print("note: --distributed uses the host collate path (the "
-                  "device cache is single-device); throughput is not "
-                  "comparable to cached runs", file=sys.stderr)
-        ds = DataSet.array(samples).transform(
+    n_dev = len(jax.devices())
+    if args.distributed and args.batchSize % n_dev != 0:
+        print(f"note: batch {args.batchSize} does not divide by "
+              f"{n_dev} devices; using the host collate path (the sharded "
+              "cache needs divisible batches)", file=sys.stderr)
+        args.no_device_cache = True
+    if args.no_device_cache:
+        ds = DataSet.array(samples, distributed=args.distributed).transform(
             SampleToBatch(batch_size=args.batchSize))
     else:
         # device-resident cache (reference CachedDistriDataSet semantics:
         # samples cached once, only indexes reshuffle per epoch) — the host
         # stack + H2D path otherwise dominates on slow-transfer backends;
-        # bf16 runs cache in bf16 (half the one-time transfer + footprint)
+        # bf16 runs cache in bf16 (half the one-time transfer + footprint).
+        # Distributed runs shard the cache over the data axis
+        # (DistriOptimizer injects its mesh; per-shard reshuffle).
         from bigdl_tpu.dataset import DeviceCachedDataSet
         ds = DeviceCachedDataSet(
-            DataSet.array(samples), batch_size=args.batchSize,
+            DataSet.array(samples, distributed=args.distributed),
+            batch_size=args.batchSize,
             cast_dtype="bfloat16" if (args.precision == "bf16"
                                       and not int_vocab) else None)
 

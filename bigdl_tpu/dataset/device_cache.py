@@ -91,6 +91,24 @@ class DeviceCachedDataSet(AbstractDataSet[MiniBatch]):
         self._x = None
         self._y = None
         self._perm = None
+        self._mesh = None
+        self._data_axis = None
+        self._gather_fn = None
+
+    def set_mesh(self, mesh, data_axis: str = "data") -> None:
+        """Shard the cache over the mesh's data axis (the reference's
+        per-partition `CachedDistriDataSet`, taken SPMD). Called by
+        DistriOptimizer before materialization; shuffling then permutes
+        WITHIN each shard (reference semantics: each partition reshuffles
+        its own indexes) and batches are per-shard ``shard_map`` gathers —
+        local by construction, no cross-device data motion."""
+        if self._x is not None and self._mesh is not mesh:
+            raise RuntimeError("DeviceCachedDataSet already materialized; "
+                               "set_mesh must precede the first epoch")
+        if data_axis in mesh.shape and mesh.shape[data_axis] > 1:
+            self._mesh = mesh
+            self._data_axis = data_axis
+        # a 1-wide (or absent) data axis degenerates to the local cache
 
     # ------------------------------------------------------------------ cache
     def _scan_for_stochastic_stages(self) -> None:
@@ -124,7 +142,10 @@ class DeviceCachedDataSet(AbstractDataSet[MiniBatch]):
             labels.append(s.label)
         if not feats:
             raise ValueError("DeviceCachedDataSet: wrapped dataset is empty")
-        if len(feats) < self.batch_size:
+        if self._mesh is None and len(feats) < self.batch_size:
+            # batch_size is GLOBAL; under a multi-process mesh the local
+            # record count is a per-process slice — the sharded branch
+            # checks the global total itself
             raise ValueError(
                 f"DeviceCachedDataSet: {len(feats)} samples cannot fill one "
                 f"batch of {self.batch_size}")
@@ -132,11 +153,62 @@ class DeviceCachedDataSet(AbstractDataSet[MiniBatch]):
         if self.cast_dtype:
             import ml_dtypes  # noqa: F401 - registers bfloat16 with numpy
             x = x.astype(self.cast_dtype)
-        self._x = jnp.asarray(x)
         y = np.stack([np.asarray(l) for l in labels])
         if y.ndim == 2 and y.shape[1] == 1:
             y = y[:, 0]  # SampleToBatch's (N,1)->(N,) label squeeze parity
-        self._y = jnp.asarray(y)
+        if self._mesh is None:
+            self._x = jnp.asarray(x)
+            self._y = jnp.asarray(y)
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        d = self._mesh.shape[self._data_axis]
+        if self.batch_size % d != 0:
+            raise ValueError(
+                f"batch_size {self.batch_size} must divide by the data-axis "
+                f"size {d} for the sharded cache")
+        # equal shards: x holds this PROCESS's records (the wrapped
+        # DistributedDataSet yields the per-process slice), covering
+        # d / process_count local shards
+        if d % jax.process_count() != 0:
+            raise ValueError(
+                f"sharded cache needs the data-axis size ({d}) to divide by "
+                f"the process count ({jax.process_count()}); lay the data "
+                "axis out across processes evenly or skip the cache")
+        d_local = d // jax.process_count()
+        n_local = (x.shape[0] // d_local) * d_local
+        x, y = x[:n_local], y[:n_local]
+        if n_local * jax.process_count() < self.batch_size:
+            raise ValueError(
+                f"{n_local * jax.process_count()} samples cannot fill one "
+                f"sharded batch of {self.batch_size} over {d} shards")
+        sharding = NamedSharding(self._mesh, P(self._data_axis))
+        if jax.process_count() > 1:
+            self._x = jax.make_array_from_process_local_data(sharding, x)
+            self._y = jax.make_array_from_process_local_data(sharding, y)
+        else:
+            self._x = jax.device_put(jnp.asarray(x), sharding)
+            self._y = jax.device_put(jnp.asarray(y), sharding)
+
+    def _sharded_gather(self):
+        """Jitted per-shard gather: local indices pick local rows — no
+        cross-device data motion, and the output lands exactly in the
+        data-parallel batch sharding."""
+        if self._gather_fn is None:
+            import jax
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+            ax = self._data_axis
+
+            def gather(xs, ys, il):
+                # local shapes: xs (S, ...), il (1, Bs) -> (Bs, ...)
+                return xs[il[0]], ys[il[0]]
+
+            self._gather_fn = jax.jit(shard_map(
+                gather, mesh=self._mesh,
+                in_specs=(P(ax), P(ax), P(ax, None)),
+                out_specs=(P(ax), P(ax))))
+        return self._gather_fn
 
     # --------------------------------------------------------------- protocol
     def data(self, train: bool) -> Iterator[MiniBatch]:
@@ -144,6 +216,39 @@ class DeviceCachedDataSet(AbstractDataSet[MiniBatch]):
         import jax.numpy as jnp
         n = int(self._x.shape[0])
         n_batches = n // self.batch_size  # static shapes: drop remainder
+        if self._mesh is not None:
+            d = self._mesh.shape[self._data_axis]
+            bs = self.batch_size // d
+            s = n // d
+            if train:
+                if self._perm is None:
+                    self.shuffle()
+                lperm, self._perm = self._perm, None  # (d, S) local indices
+            else:
+                # eval: fixed per-shard round-robin (every record exactly
+                # once; global order interleaves shards, unlike the host
+                # path — evaluators aggregate, so order is immaterial)
+                lperm = np.broadcast_to(np.arange(s, dtype=np.int32),
+                                        (d, s))
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ish = NamedSharding(self._mesh, P(self._data_axis, None))
+            if jax.process_count() > 1:
+                # each process contributes its own shards' rows (its local
+                # RNG generated them; remote rows in lperm are ignored)
+                d_local = d // jax.process_count()
+                lo = jax.process_index() * d_local
+                idx_dev = jax.make_array_from_process_local_data(
+                    ish, np.ascontiguousarray(lperm[lo:lo + d_local]))
+            else:
+                idx_dev = jax.device_put(
+                    jnp.asarray(np.ascontiguousarray(lperm)), ish)
+            gather = self._sharded_gather()
+            for b in range(n // self.batch_size):
+                il = idx_dev[:, b * bs:(b + 1) * bs]
+                xb, yb = gather(self._x, self._y, il)
+                yield MiniBatch(xb, yb)
+            return
         if train:
             if self._perm is None:
                 self.shuffle()
@@ -169,12 +274,24 @@ class DeviceCachedDataSet(AbstractDataSet[MiniBatch]):
         # silently clamp or truncate gathers
         self._materialize()
         n = int(self._x.shape[0])
+        rng = RandomGenerator.RNG()
+        if self._mesh is not None:
+            # per-shard local permutations (reference semantics: each
+            # cached partition reshuffles its OWN indexes,
+            # DataSet.scala:292-299); randperm is 1-based -> -1
+            d = self._mesh.shape[self._data_axis]
+            s = n // d
+            self._perm = np.stack(
+                [np.asarray(rng.randperm(s) - 1, np.int32)
+                 for _ in range(d)])
+            return
         # randperm is 1-based (Torch semantics); indices here are 0-based
-        self._perm = np.asarray(RandomGenerator.RNG().randperm(n) - 1,
-                                np.int32)
+        self._perm = np.asarray(rng.randperm(n) - 1, np.int32)
 
     def is_distributed(self) -> bool:
-        return False
+        # routes the Optimizer factory: a cache over a distributed base (or
+        # an injected mesh) trains through DistriOptimizer
+        return self._mesh is not None or self.base.is_distributed()
 
     def transform(self, transformer):
         raise TypeError(

@@ -86,6 +86,11 @@ class DistriOptimizer(LocalOptimizer):
         batch_spec = P(DATA_AXIS) if DATA_AXIS in self.mesh.shape else P()
         self._batch_sharding = NamedSharding(self.mesh, batch_spec)
         self._replicated = NamedSharding(self.mesh, P())
+        # a DeviceCachedDataSet shards its cache over our data axis
+        # (per-partition cache ≙ reference CachedDistriDataSet)
+        from bigdl_tpu.dataset.device_cache import DeviceCachedDataSet
+        if isinstance(dataset, DeviceCachedDataSet):
+            dataset.set_mesh(self.mesh, DATA_AXIS)
 
     # ------------------------------------------------------------- placement
     def _place_batch(self, batch):
@@ -97,6 +102,14 @@ class DistriOptimizer(LocalOptimizer):
         reference's executor-pinned partitions, ``CachedDistriDataSet``);
         ``jax.make_array_from_process_local_data`` assembles the global
         array without any host ever holding the full batch."""
+        data = batch.data
+        if (isinstance(data, jax.Array) and hasattr(data, "sharding")
+                and isinstance(data.sharding, NamedSharding)
+                and data.sharding.mesh is self.mesh):
+            # sharded-cache batches arrive already placed on this mesh
+            # (shard_map gather output) — re-placing would force a gather
+            # of non-addressable shards under multi-host
+            return data, batch.labels
         if jax.process_count() > 1:
             data = jax.make_array_from_process_local_data(
                 self._batch_sharding, np.asarray(batch.data))
@@ -171,6 +184,18 @@ class DistriOptimizer(LocalOptimizer):
         over executors (``optim/Evaluator.scala:48-73``)."""
         if jax.process_count() <= 1:
             return super()._run_validation(params, buffers, fwd)
+        from bigdl_tpu.dataset.device_cache import DeviceCachedDataSet
+        if (isinstance(self.validation_dataset, DeviceCachedDataSet)
+                and self.validation_dataset._mesh is not None):
+            # the sharded cache yields GLOBAL arrays; this path evaluates
+            # host-locally per process and allgather-merges, so it needs a
+            # per-process host dataset — mixing the two would crash on
+            # non-addressable shards (or double-count every record)
+            raise ValueError(
+                "multi-host validation needs a host-path distributed "
+                "dataset (per-process record slices), not a sharded "
+                "DeviceCachedDataSet; pass the un-cached pipeline to "
+                "set_validation")
         from jax.experimental import multihost_utils
         from bigdl_tpu.optim.evaluator import evaluate_batches
 

@@ -45,18 +45,27 @@ def main():
     n_dev = jax.device_count()
 
     results = {}
-    for sync_mode in ("allreduce", "sharded"):
+    # "cached" = allreduce sync over the SHARDED DeviceCachedDataSet (the
+    # per-partition cache: per-process materialization via
+    # make_array_from_process_local_data, per-shard reshuffle)
+    for sync_mode in ("allreduce", "sharded", "cached"):
         manual_seed(42)
         rng = np.random.default_rng(0)
         samples = [Sample(rng.normal(0, 1, (28, 28, 1)).astype("float32"),
                           float(rng.integers(1, 11)))
                    for _ in range(32)]
-        ds = (DataSet.array(samples, distributed=True)
-              >> SampleToBatch(32 // nproc))
+        if sync_mode == "cached":
+            from bigdl_tpu.dataset import DeviceCachedDataSet
+            ds = DeviceCachedDataSet(
+                DataSet.array(samples, distributed=True), batch_size=32)
+        else:
+            ds = (DataSet.array(samples, distributed=True)
+                  >> SampleToBatch(32 // nproc))
         model = lenet.build(10)
         opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
                         topology=MeshTopology(data=n_dev))
-        opt.sync_mode = sync_mode
+        opt.sync_mode = ("allreduce" if sync_mode == "cached"
+                         else sync_mode)
         opt.set_optim_method(SGD(learningrate=0.05, momentum=0.9))
         opt.set_end_when(Trigger.max_iteration(3))
         trained = opt.optimize()

@@ -183,3 +183,79 @@ def test_k_fused_dispatch_over_cache_matches_k1(monkeypatch):
 
     for a, b in zip(run(1), run(4)):
         np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)
+
+
+class TestShardedCache:
+    """Sharded device cache under DistriOptimizer (8-device virtual mesh):
+    per-shard reshuffle (reference CachedDistriDataSet's per-partition
+    semantics), shard_map-local gathers, factory routing."""
+
+    def _samples(self, n):
+        rng = np.random.default_rng(9)
+        return [Sample(rng.normal(0, 1, (28, 28, 1)).astype(np.float32),
+                       float(rng.integers(1, 11))) for _ in range(n)]
+
+    def test_routes_to_distri_and_trains(self):
+        from bigdl_tpu.dataset import mnist
+        from bigdl_tpu.dataset.image import (BytesToGreyImg,
+                                             GreyImgNormalizer)
+        from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
+        bt.utils.manual_seed(41)
+        raw = (DataSet.array(mnist.synthetic(512), distributed=True)
+               >> BytesToGreyImg(28, 28) >> GreyImgNormalizer(33., 78.))
+        ds = DeviceCachedDataSet(raw, batch_size=64)
+        opt = Optimizer(lenet.build(10), ds, nn.ClassNLLCriterion())
+        assert isinstance(opt, DistriOptimizer)
+        opt.set_optim_method(SGD(learningrate=0.1, momentum=0.9))
+        opt.set_end_when(Trigger.max_epoch(4))
+        trained = opt.optimize()
+        from bigdl_tpu.optim import Top1Accuracy
+        acc = trained.evaluate(ds, [Top1Accuracy()])[0][0].result()[0]
+        assert acc > 0.5, f"sharded-cache training failed: acc={acc}"
+
+    def test_epoch_is_within_shard_permutation(self):
+        from bigdl_tpu.parallel.mesh import MeshTopology
+        mesh = MeshTopology(data=4).build()
+        samples = [Sample(np.full((2,), i, np.float32), 1.0)
+                   for i in range(16)]
+        ds = DeviceCachedDataSet(DataSet.array(samples), batch_size=8)
+        ds.set_mesh(mesh, "data")
+        bt.utils.manual_seed(43)
+        feats = np.concatenate([np.asarray(b.data)[:, 0]
+                                for b in ds.data(train=True)])
+        # every sample exactly once
+        np.testing.assert_array_equal(np.sort(feats), np.arange(16))
+        # batch layout: rows grouped per shard (B/d from each shard), and
+        # each shard's rows drawn only from that shard's quarter
+        for b in range(2):
+            batch = feats[b * 8:(b + 1) * 8].reshape(4, 2)
+            for s in range(4):
+                assert set(batch[s] // 4) == {s}, (b, s, batch)
+
+    def test_eval_covers_every_record_once(self):
+        from bigdl_tpu.parallel.mesh import MeshTopology
+        mesh = MeshTopology(data=4).build()
+        samples = [Sample(np.full((2,), i, np.float32), 1.0)
+                   for i in range(16)]
+        ds = DeviceCachedDataSet(DataSet.array(samples), batch_size=8)
+        ds.set_mesh(mesh, "data")
+        feats = np.concatenate([np.asarray(b.data)[:, 0]
+                                for b in ds.data(train=False)])
+        np.testing.assert_array_equal(np.sort(feats), np.arange(16))
+
+    def test_rejects_indivisible_batch(self):
+        from bigdl_tpu.parallel.mesh import MeshTopology
+        mesh = MeshTopology(data=8).build()
+        ds = DeviceCachedDataSet(DataSet.array(self._samples(64)),
+                                 batch_size=12)  # 12 % 8 != 0
+        ds.set_mesh(mesh, "data")
+        with pytest.raises(ValueError, match="data-axis"):
+            list(ds.data(train=False))
+
+    def test_set_mesh_after_materialize_rejected(self):
+        from bigdl_tpu.parallel.mesh import MeshTopology
+        ds = DeviceCachedDataSet(DataSet.array(self._samples(16)),
+                                 batch_size=8)
+        list(ds.data(train=False))
+        with pytest.raises(RuntimeError, match="materialized"):
+            ds.set_mesh(MeshTopology(data=4).build(), "data")

@@ -39,12 +39,17 @@ def _single_process_reference(sync_mode: str):
     samples = [Sample(rng.normal(0, 1, (28, 28, 1)).astype("float32"),
                       float(rng.integers(1, 11)))
                for _ in range(32)]
-    ds = DataSet.array(samples, distributed=True) >> SampleToBatch(32)
+    if sync_mode == "cached":
+        from bigdl_tpu.dataset import DeviceCachedDataSet
+        ds = DeviceCachedDataSet(
+            DataSet.array(samples, distributed=True), batch_size=32)
+    else:
+        ds = DataSet.array(samples, distributed=True) >> SampleToBatch(32)
     model = lenet.build(10)
     opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
                     topology=MeshTopology(data=4,
                                           devices=jax.devices()[:4]))
-    opt.sync_mode = sync_mode
+    opt.sync_mode = "allreduce" if sync_mode == "cached" else sync_mode
     opt.set_optim_method(SGD(learningrate=0.05, momentum=0.9))
     opt.set_end_when(Trigger.max_iteration(3))
     trained = opt.optimize()
@@ -79,7 +84,7 @@ def test_multi_process_training_matches_single_process(tmp_path, n_procs,
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
 
-    for sync_mode in ("allreduce", "sharded"):
+    for sync_mode in ("allreduce", "sharded", "cached"):
         path = tmp_path / f"params_{sync_mode}.npz"
         assert path.exists(), f"worker 0 did not write {path}"
         multi = list(np.load(path).values())
