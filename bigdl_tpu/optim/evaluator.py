@@ -14,6 +14,7 @@ from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bigdl_tpu.dataset.base import (AbstractDataSet, LocalDataSet, MiniBatch,
                                     Sample, SampleToBatch)
@@ -30,6 +31,7 @@ def _as_minibatch(item) -> MiniBatch:
 def evaluate_batches(fwd: Callable, params, buffers,
                      batches: Iterable,
                      v_methods: Sequence[ValidationMethod],
+                     cache: Optional[dict] = None,
                      ) -> Tuple[List[Optional[ValidationResult]], int]:
     """Run ``fwd(params, buffers, data)`` over batches, merging each method's
     ValidationResults. Returns (results, record_count).
@@ -43,24 +45,76 @@ def evaluate_batches(fwd: Callable, params, buffers,
     count = 0
     full_bs: Optional[int] = None
     sliceable: Optional[bool] = None  # learned from the first (full) batch
+    # Device-side accumulation (steady state): one jitted dispatch per
+    # batch carries a donated (M, 2) [value, count] accumulator — the
+    # per-batch ``float(v)`` host syncs otherwise dominate eval on
+    # dispatch-latency-bound backends (each sync ~a full RPC round trip).
+    # Callers that evaluate repeatedly (the training loop's validation
+    # trigger) pass a persistent ``cache`` dict so the scorer jit is traced
+    # ONCE, not per validation (a per-call retrace costs seconds and undoes
+    # the win).
+    # The fast path jits each method's pure device core. A custom subclass
+    # that overrides only apply() (the old per-batch contract) has no such
+    # core — run the whole loop on the compatible eager path for it.
+    from bigdl_tpu.optim.validation import ValidationMethod as _VM
+    fast_ok = all(type(m).batch_result is not _VM.batch_result
+                  for m in v_methods)
+    # id()-keyed: exact and collision-safe (the cached closure pins the
+    # objects alive). Callers constructing FRESH method instances per call
+    # miss the cache and pay a retrace — reuse method objects across
+    # evaluations (the training loop's validation path does).
+    cache_key = (id(fwd),) + tuple(id(m) for m in v_methods)
+    scorer = (cache or {}).get(cache_key)
+    acc = None
     for item in batches:
         batch = _as_minibatch(item)
         n = batch.size()
         data = jnp.asarray(batch.data)
         if full_bs is None:
             full_bs = n
+        labels = jnp.asarray(batch.labels)
+        if fast_ok and sliceable and n == full_bs:
+            if scorer is None:
+                def scorer_fn(p, b, x, y, a):
+                    out = fwd(p, b, x)
+                    av, ac = a
+                    # values accumulate f32 (per-batch sums are f32 device
+                    # results anyway); counts accumulate int32 — EXACT to
+                    # 2^31 records where an f32 count goes wrong past 2^24
+                    pairs = [m.batch_result(out, y) for m in v_methods]
+                    vs = jnp.stack([jnp.asarray(v).astype(jnp.float32)
+                                    for v, _ in pairs])
+                    cs = jnp.stack([jnp.asarray(c).astype(jnp.int32)
+                                    for _, c in pairs])
+                    return av + vs, ac + cs
+
+                scorer = jax.jit(scorer_fn, donate_argnums=(4,))
+                if cache is not None:
+                    cache.clear()  # fwd/methods changed: old entry is stale
+                    cache[cache_key] = scorer
+            if acc is None:
+                acc = (jnp.zeros((len(v_methods),), jnp.float32),
+                       jnp.zeros((len(v_methods),), jnp.int32))
+            acc = scorer(params, buffers, data, labels, acc)
+            count += n
+            continue
         if n < full_bs and sliceable:
             pad = jnp.zeros((full_bs - n, *data.shape[1:]), data.dtype)
             out = fwd(params, buffers, jnp.concatenate([data, pad]))[:n]
-        else:  # full batch, or structured output needing the exact shape
+        else:  # first batch, or structured output needing the exact shape
             out = fwd(params, buffers, data)
             if sliceable is None:
                 sliceable = isinstance(out, jax.Array)
-        labels = jnp.asarray(batch.labels)
         for i, m in enumerate(v_methods):
             r = m.apply(out, labels)
             results[i] = r if results[i] is None else results[i] + r
         count += n
+    if acc is not None:
+        vals = np.asarray(acc[0])  # the ONE device->host sync
+        counts = np.asarray(acc[1])
+        for i, m in enumerate(v_methods):
+            r = m.to_result(float(vals[i]), int(counts[i]))
+            results[i] = r if results[i] is None else results[i] + r
     return results, count
 
 
@@ -70,6 +124,7 @@ class Evaluator:
     def __init__(self, model: Module, batch_size: int = 128):
         self.model = model
         self.batch_size = batch_size
+        self._eval_cache = {}  # scorer jit, traced once per (fwd, methods)
 
     def _as_batches(self, dataset):
         if isinstance(dataset, AbstractDataSet):
@@ -80,20 +135,24 @@ class Evaluator:
         return ds.data(train=False)
 
     def _fwd(self):
-        model = self.model
+        # cached: repeated .test() calls (an eval loop) must not retrace
+        if getattr(self, "_fwd_jit", None) is None:
+            model = self.model
 
-        @jax.jit
-        def fwd(p, b, x):
-            out, _ = functional_apply(model, p, b, x, training=False)
-            return out
+            @jax.jit
+            def fwd(p, b, x):
+                out, _ = functional_apply(model, p, b, x, training=False)
+                return out
 
-        return fwd
+            self._fwd_jit = fwd
+        return self._fwd_jit
 
     def test(self, dataset, v_methods: Sequence[ValidationMethod]
              ) -> List[Tuple[ValidationResult, ValidationMethod]]:
         params, buffers = self.model.functional_state()
         results, _ = evaluate_batches(self._fwd(), params, buffers,
-                                      self._as_batches(dataset), v_methods)
+                                      self._as_batches(dataset), v_methods,
+                                      cache=self._eval_cache)
         return [(r, m) for r, m in zip(results, v_methods)]
 
 

@@ -152,6 +152,7 @@ class Optimizer:
         self._profile: Optional[Tuple[str, int, int]] = None
         self._remat = False
         self._steps_per_dispatch = 1
+        self._eval_cache = {}  # validation scorer jit, traced once
         from bigdl_tpu.ops.precision import DtypePolicy
         self.precision = DtypePolicy.fp32()
 
@@ -736,7 +737,7 @@ class LocalOptimizer(Optimizer):
         from bigdl_tpu.optim.evaluator import evaluate_batches
         return evaluate_batches(
             fwd, params, buffers, self.validation_dataset.data(train=False),
-            self.validation_methods)
+            self.validation_methods, cache=self._eval_cache)
 
     def _validate(self, params, buffers, fwd, driver_state) -> None:
         if self.validation_dataset is None:

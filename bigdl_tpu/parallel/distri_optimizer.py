@@ -213,7 +213,7 @@ class DistriOptimizer(LocalOptimizer):
         results, count = evaluate_batches(
             self._local_eval_fwd, params_h, buffers_h,
             self.validation_dataset.data(train=False),
-            self.validation_methods)
+            self.validation_methods, cache=self._eval_cache)
         states = np.array(
             [list(r.state()) if r is not None else [0.0, 0.0]
              for r in results] + [[float(count), 0.0]], np.float64)

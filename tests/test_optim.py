@@ -249,3 +249,32 @@ class TestWolfeLineSearch:
         x, losses = LBFGS(max_iter=30, linesearch=True).optimize(
             feval, jnp.asarray([0.0, 0.0]))
         assert losses[-1] < 1e-5, losses[-1]
+
+
+def test_apply_only_custom_validation_method_still_works():
+    # The device-accumulated eval fast path needs batch_result(); a custom
+    # metric overriding only apply() (the old public contract) must fall
+    # back to the eager path, not hit the base-class stub under jit.
+    import jax.numpy as jnp
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+    from bigdl_tpu.optim.validation import AccuracyResult, ValidationMethod
+
+    class ApplyOnlyTop1(ValidationMethod):
+        name = "ApplyOnlyTop1"
+
+        def apply(self, output, target):
+            pred = jnp.argmax(output, axis=-1) + 1
+            return AccuracyResult(int(jnp.sum(pred == target)),
+                                  int(target.shape[0]))
+
+    rng = np.random.RandomState(3)
+    samples = [Sample(rng.randn(4).astype(np.float32),
+                      np.float32(rng.randint(1, 3))) for _ in range(24)]
+    model = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+    ds = DataSet.array(samples) >> SampleToBatch(8)
+    from bigdl_tpu.optim import Top1Accuracy
+    res = model.evaluate(ds, [ApplyOnlyTop1(), Top1Accuracy()])
+    # both metrics scored every record, and they agree
+    assert res[0][0].count == res[1][0].count == 24
+    assert res[0][0].correct == res[1][0].correct
