@@ -6,3 +6,5 @@ from bigdl_tpu.models import resnet
 from bigdl_tpu.models import inception
 from bigdl_tpu.models import autoencoder
 from bigdl_tpu.models import rnn
+from bigdl_tpu.models import transformer
+from bigdl_tpu.models.generation import generate

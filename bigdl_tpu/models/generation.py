@@ -1,0 +1,185 @@
+"""Autoregressive generation for causal LMs — KV-cached, fully jitted.
+
+New TPU-native capability (the reference stops at training + batch predict:
+``optim/Predictor.scala``; it has no sequence decoding of any kind). The
+flagship transformer LM (``models/transformer.build_lm``) needs a sampling
+path for a user to actually *use* the model, so this module provides one,
+designed XLA-first:
+
+- the KV cache is module BUFFER state, so the existing ``functional_apply``
+  machinery threads it functionally — the decode loop is a single jitted
+  program: one prefill forward over the prompt, then ``lax.scan`` over the
+  new-token steps (one token per step, cache carried through the scan);
+- shapes are static: the cache is allocated at ``prompt_len + max_new``
+  up front, finished sequences are masked, never resized (XLA requirement);
+- sampling (greedy / temperature / top-k / nucleus top-p) runs on-device
+  inside the same program via ``jax.random.categorical``.
+
+Token ids follow the framework's 1-based Torch convention (LookupTable,
+ClassNLLCriterion): valid ids are ``1..vocab_size``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.attention import MultiHeadAttention, PositionalEncoding
+from bigdl_tpu.nn.linear import LMHead
+from bigdl_tpu.nn.module import Module, functional_apply
+from bigdl_tpu.nn.recurrent import TimeDistributed
+
+
+def filter_top_k(logprobs: jax.Array, k: int) -> jax.Array:
+    """Keep the k highest-probability tokens; the rest get -inf."""
+    if k <= 0 or k >= logprobs.shape[-1]:
+        return logprobs
+    kth = jax.lax.top_k(logprobs, k)[0][..., -1:]
+    return jnp.where(logprobs < kth, -jnp.inf, logprobs)
+
+def filter_top_p(logprobs: jax.Array, p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest set of tokens whose cumulative
+    probability reaches ``p`` (always at least the argmax). Input must be
+    normalised log-probabilities."""
+    if p <= 0.0 or p >= 1.0:
+        return logprobs
+    sorted_lp = jnp.flip(jnp.sort(logprobs, axis=-1), axis=-1)
+    cum = jnp.cumsum(jnp.exp(sorted_lp), axis=-1)
+    # token kept iff the mass BEFORE it is still < p (top-1 always kept)
+    keep = (cum - jnp.exp(sorted_lp)) < p
+    thresh = jnp.min(jnp.where(keep, sorted_lp, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logprobs < thresh, -jnp.inf, logprobs)
+
+def sample_token(logprobs: jax.Array, key: Optional[jax.Array], *,
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 0.0, greedy: bool = False) -> jax.Array:
+    """One sampling step over (B, V) log-probs -> (B,) 1-based token ids."""
+    if greedy:
+        return jnp.argmax(logprobs, axis=-1).astype(jnp.int32) + 1
+    lp = logprobs.astype(jnp.float32)
+    if temperature != 1.0:
+        lp = lp / max(float(temperature), 1e-6)
+    lp = filter_top_k(lp, top_k)
+    # re-normalise after top-k so top_p trims the nucleus of the REMAINING
+    # distribution (standard composed semantics; filter_top_p requires
+    # normalised log-probs)
+    lp = filter_top_p(jax.nn.log_softmax(lp, axis=-1), top_p)
+    return jax.random.categorical(key, lp, axis=-1).astype(jnp.int32) + 1
+
+
+def _decode_modules(model: Module):
+    mhas = [m for m in model.modules() if isinstance(m, MultiHeadAttention)]
+    pes = [m for m in model.modules() if isinstance(m, PositionalEncoding)]
+    # LM-head tails compute only the LAST position while decoding — the
+    # prefill otherwise materialises (B, S0, V) log-probs just to sample
+    # one token (TimeDistributed slices likewise: in an autoregressive LM
+    # it only ever appears as the vocab head)
+    heads = [m for m in model.modules()
+             if isinstance(m, (LMHead, TimeDistributed))]
+    if not mhas:
+        raise ValueError("generate() needs a model with MultiHeadAttention "
+                         "layers (see models/transformer.build_lm)")
+    return mhas, pes, heads
+
+
+def _build_decode_fn(model: Module, max_new_tokens: int, temperature: float,
+                     top_k: int, top_p: float, greedy: bool,
+                     eos_id: Optional[int], pad_id: int):
+    """Pure (params, buffers, prompt, key) -> (B, S0+max_new) id matrix."""
+
+    def sample(logp, key):
+        return sample_token(logp, key, temperature=temperature, top_k=top_k,
+                            top_p=top_p, greedy=greedy)
+
+    def run(params, buffers, prompt, key):
+        out, bufs = functional_apply(model, params, buffers, prompt,
+                                     training=False)
+        key, sub = jax.random.split(key)
+        tok = sample(out[:, -1].astype(jnp.float32), sub)
+        if eos_id is None:
+            done = jnp.zeros(tok.shape, bool)
+        else:
+            done = tok == eos_id
+
+        def body(carry, _):
+            bufs, tok, key, done = carry
+            step_in = tok[:, None].astype(prompt.dtype)
+            out, bufs = functional_apply(model, params, bufs, step_in,
+                                         training=False)
+            key, sub = jax.random.split(key)
+            nxt = sample(out[:, -1].astype(jnp.float32), sub)
+            nxt = jnp.where(done, jnp.int32(pad_id), nxt)
+            if eos_id is not None:
+                done = done | (nxt == eos_id)
+            return (bufs, nxt, key, done), nxt
+
+        (_, _, _, _), rest = jax.lax.scan(
+            body, (bufs, tok, key, done), None, length=max_new_tokens - 1)
+        toks = jnp.concatenate([tok[:, None], rest.T], axis=1)
+        return jnp.concatenate([prompt, toks.astype(prompt.dtype)], axis=1)
+
+    return jax.jit(run)
+
+
+def generate(model: Module, prompt, max_new_tokens: int, *,
+             temperature: float = 1.0, top_k: int = 0, top_p: float = 0.0,
+             greedy: bool = False, eos_id: Optional[int] = None,
+             pad_id: Optional[int] = None,
+             key: Optional[jax.Array] = None) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt``.
+
+    ``prompt``: (B, S) or (S,) 1-based token ids (any numeric dtype).
+    Returns prompt+continuation, shape (B, S + max_new_tokens). Sequences
+    that emit ``eos_id`` are frozen: subsequent positions hold ``pad_id``
+    (default: ``eos_id``). Sampling is greedy when ``greedy`` or
+    ``temperature + filters`` select it deterministically; otherwise draws
+    use ``key`` (default PRNGKey(0) — pass your own for varied samples).
+
+    The whole decode — prompt prefill, per-token steps, sampling — is one
+    jitted program per (shape, sampling-config); compiled programs are
+    cached on the model instance.
+    """
+    prompt = jnp.asarray(prompt)
+    squeeze = prompt.ndim == 1
+    if squeeze:
+        prompt = prompt[None]
+    if max_new_tokens <= 0:
+        return prompt[0] if squeeze else prompt
+    b, s0 = prompt.shape
+    total = s0 + max_new_tokens
+    mhas, pes, heads = _decode_modules(model)
+    for pe in pes:
+        if pe.pe.shape[0] < total:
+            raise ValueError(
+                f"model max_len {pe.pe.shape[0]} < prompt+max_new_tokens "
+                f"{total}; rebuild the model with a larger max_len")
+    if pad_id is None:
+        pad_id = eos_id if eos_id is not None else 1
+
+    was_training = model.training
+    try:
+        model.evaluate_mode()
+        for m in mhas:
+            m.enable_decode(b, total)
+        for m in pes + heads:
+            m.enable_decode()
+        params, buffers = model.functional_state()
+        cache = model.__dict__.setdefault("_generate_fns", {})
+        sig = (b, s0, max_new_tokens, float(temperature), int(top_k),
+               float(top_p), bool(greedy), eos_id, pad_id)
+        fn = cache.get(sig)
+        if fn is None:
+            fn = _build_decode_fn(model, max_new_tokens, temperature, top_k,
+                                  top_p, greedy, eos_id, pad_id)
+            cache[sig] = fn
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        out = fn(params, buffers, prompt, key)
+    finally:
+        for m in mhas + pes + heads:
+            m.disable_decode()
+        model.set_training(was_training)
+    return out[0] if squeeze else out

@@ -78,6 +78,10 @@ class MultiHeadAttention(Module):
     q;k;v, each (E, E)) so oracle tests and weight import line up.
     """
 
+    # class attribute (not set in __init__) so checkpoints pickled before
+    # decode mode existed still forward correctly after load
+    _decode = False
+
     def __init__(self, embed_dim: int, num_heads: int,
                  dropout: float = 0.0, with_bias: bool = True,
                  causal: bool = False, block_size: int = 0,
@@ -114,6 +118,52 @@ class MultiHeadAttention(Module):
             self.register_parameter("in_proj_bias", init.zeros((3 * embed_dim,)))
             self.register_parameter("out_proj_bias", init.zeros((embed_dim,)))
         self.attn_mask: Optional[jax.Array] = None
+
+    # ------------------------------------------------------------- decoding
+    def enable_decode(self, batch_size: int, max_len: int) -> "MultiHeadAttention":
+        """Switch to incremental-decode mode with a (B, max_len) KV cache.
+
+        The cache and write position are registered BUFFERS, so under
+        ``functional_apply`` they thread functionally: each traced forward
+        returns a new buffer tree with the appended K/V and advanced
+        position — exactly the carry a jitted ``lax.scan`` decode loop
+        needs (``models/generation.py``). The module object itself is never
+        mutated by traced steps."""
+        if self.seq_axis is not None:
+            raise ValueError("decode mode is incompatible with "
+                             "context-parallel attention (seq_axis)")
+        dt = self.in_proj_weight.dtype
+        shape = (batch_size, max_len, self.num_heads, self.head_dim)
+        self._decode = True
+        self.register_buffer("k_cache", jnp.zeros(shape, dt))
+        self.register_buffer("v_cache", jnp.zeros(shape, dt))
+        self.register_buffer("decode_pos", jnp.zeros((), jnp.int32))
+        return self
+
+    def disable_decode(self) -> "MultiHeadAttention":
+        self._decode = False
+        for name in ("k_cache", "v_cache", "decode_pos"):
+            self._buffers.pop(name, None)
+        return self
+
+    def _attend_decode(self, q, k, v):
+        """Append k/v at ``decode_pos`` and attend q against the cache.
+
+        Works for both the prompt prefill (S > 1 at pos 0) and the one-token
+        steady state (S = 1); causality across the cache is a position mask
+        ``k_pos <= q_pos`` so stale tail entries never attend."""
+        from bigdl_tpu.ops import attention_core
+        pos = self.decode_pos
+        self.k_cache = jax.lax.dynamic_update_slice(
+            self.k_cache, k.astype(self.k_cache.dtype), (0, pos, 0, 0))
+        self.v_cache = jax.lax.dynamic_update_slice(
+            self.v_cache, v.astype(self.v_cache.dtype), (0, pos, 0, 0))
+        s = q.shape[1]
+        self.decode_pos = pos + s
+        k_pos = jnp.arange(self.k_cache.shape[1])[None, :]
+        q_pos = pos + jnp.arange(s)[:, None]
+        return attention_core.dot_product_attention(
+            q, self.k_cache, self.v_cache, mask=k_pos <= q_pos, causal=False)
 
     def set_mask(self, mask: Optional[jax.Array]) -> "MultiHeadAttention":
         """Static structural mask (baked in at trace time — see class doc;
@@ -155,7 +205,10 @@ class MultiHeadAttention(Module):
         k = self._split_heads(self._project(key, wk, bk))
         v = self._split_heads(self._project(value, wv, bv))
 
-        ctx = self._attend(q, k, v, mask)
+        if self._decode:
+            ctx = self._attend_decode(q, k, v)
+        else:
+            ctx = self._attend(q, k, v, mask)
 
         b, s, _, _ = ctx.shape
         ctx = ctx.reshape(b, s, e)
@@ -195,6 +248,8 @@ class MultiHeadAttention(Module):
 class PositionalEncoding(TensorModule):
     """Sinusoidal position encoding added to (B, S, E) input."""
 
+    _decode = False  # class attr: see MultiHeadAttention._decode
+
     def __init__(self, embed_dim: int, max_len: int = 4096,
                  dropout: float = 0.0):
         super().__init__()
@@ -207,8 +262,25 @@ class PositionalEncoding(TensorModule):
         pe[:, 1::2] = np.cos(pos * div[: embed_dim // 2])
         self.register_buffer("pe", pe)
 
+    def enable_decode(self) -> "PositionalEncoding":
+        """Incremental mode: positions continue from a buffer-tracked offset
+        (threaded functionally by ``functional_apply``, like the KV cache)."""
+        self._decode = True
+        self.register_buffer("decode_pos", jnp.zeros((), jnp.int32))
+        return self
+
+    def disable_decode(self) -> "PositionalEncoding":
+        self._decode = False
+        self._buffers.pop("decode_pos", None)
+        return self
+
     def update_output(self, input):
         s = input.shape[1]
+        if self._decode:
+            pos = self.decode_pos
+            pe = jax.lax.dynamic_slice(self.pe, (pos, 0), (s, self.pe.shape[1]))
+            self.decode_pos = pos + s
+            return self.dropout.forward(input + pe.astype(input.dtype))
         return self.dropout.forward(input + self.pe[:s].astype(input.dtype))
 
 

@@ -306,6 +306,7 @@ class Module:
     def __getstate__(self):
         d = self.__dict__.copy()
         d.pop("_jit_forward", None)  # jit wrappers don't serialize/deepcopy
+        d.pop("_generate_fns", None)
         return d
 
     # ----------------------------------------------------- parameter flatten

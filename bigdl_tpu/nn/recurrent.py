@@ -287,11 +287,27 @@ class TimeDistributed(Module):
     ``nn/TimeDistributed.scala:36``): one reshape, one application — the
     timestep loop vanishes into the batch dim."""
 
+    _decode = False  # class attr (pickle fwd-compat), see enable_decode
+
     def __init__(self, module: Module):
         super().__init__()
         self.inner = module
 
+    def enable_decode(self) -> "TimeDistributed":
+        """Generation mode (models.generation): apply the inner module to
+        the LAST timestep only — an LM-head tail never needs the earlier
+        positions while sampling, and skipping them avoids the (B, S, V)
+        prefill logits."""
+        self._decode = True
+        return self
+
+    def disable_decode(self) -> "TimeDistributed":
+        self._decode = False
+        return self
+
     def update_output(self, input):
+        if self._decode:
+            input = input[:, -1:]
         n, t = input.shape[0], input.shape[1]
         flat = jnp.reshape(input, (n * t,) + input.shape[2:])
         out = self.inner.forward(flat)
