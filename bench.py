@@ -186,14 +186,25 @@ def _build_workload(name, batch):
         # use_flash gate routes to XLA attention (the measured in-model
         # winner there); --seq-len 1024+ dispatches the Pallas kernel
         # (PERF.md round-3 crossover)
+        # fused LM-head CE (nn.LMHead + FusedLMHeadCriterion): the (B,S,V)
+        # logits never materialise — measured +23% over the unfused tail on
+        # chip at V=32K (PERF.md round 3); loss numerics parity-tested
         model = transformer.build_lm(10000, embed_dim=256, num_heads=4,
-                                     ffn_dim=1024, num_layers=4, max_len=t)
+                                     ffn_dim=1024, num_layers=4, max_len=t,
+                                     fused_head=True)
         data = jnp.asarray(rng.integers(1, 10001, (batch, t))
                            .astype("float32"))
         labels = jnp.asarray(rng.integers(1, 10001, (batch, t))
                              .astype("float32"))
-        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
-                                           size_average=True)
+        # scale matches the previous TimeDistributedCriterion(...,
+        # size_average=True) tail (flat mean / T) so the SGD step's
+        # gradient magnitudes — and hence the measured training dynamics —
+        # stay comparable across rounds
+        class _ScaledFusedCE(nn.FusedLMHeadCriterion):
+            def update_output(self, input, target):
+                return super().update_output(input, target) / t
+
+        crit = _ScaledFusedCE()
         return model, crit, data, labels, t
     raise ValueError(name)
 

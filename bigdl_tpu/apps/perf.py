@@ -24,11 +24,13 @@ _LSTM_VOCAB = 20_000
 _TRANSFORMER_VOCAB = 32_000
 
 
-def _build_model(name: str):
+def _build_model(name: str, fused_head: bool = True):
     """(model, feature_shape, n_classes, int_vocab, seq_labels) —
     ``int_vocab > 0`` marks integer token-index features (LSTM text
     classification, BASELINE config 5); ``seq_labels`` marks per-timestep
-    targets scored with TimeDistributedCriterion (the causal LM)."""
+    targets scored with the fused LM-head criterion (default — measured
+    +23% on chip, PERF.md round 3) or TimeDistributedCriterion(ClassNLL)
+    with ``fused_head=False`` (the causal LM)."""
     from bigdl_tpu.models import (inception, lenet, resnet, rnn, transformer,
                                   vgg)
     builders = {
@@ -46,7 +48,8 @@ def _build_model(name: str):
         "lstm": lambda: (rnn.build_classifier(_LSTM_VOCAB, 128, 128, 20),
                          (500,), 20, _LSTM_VOCAB, False),
         "transformer": lambda: (transformer.build_lm(
-            _TRANSFORMER_VOCAB, 256, 8, 1024, num_layers=4, max_len=2048),
+            _TRANSFORMER_VOCAB, 256, 8, 1024, num_layers=4, max_len=2048,
+            fused_head=fused_head),
             (512,), _TRANSFORMER_VOCAB, _TRANSFORMER_VOCAB, True),
     }
     if name not in builders:
@@ -68,6 +71,9 @@ def main(argv=None) -> None:
     ap.add_argument("--stepsPerDispatch", "-k", type=int, default=1,
                     help="fuse K iterations per jitted dispatch "
                     "(set_steps_per_dispatch; local runs only)")
+    ap.add_argument("--no-fused-head", action="store_true",
+                    help="LM only: unfused TimeDistributed(Linear)+LogSoftMax"
+                    " tail + ClassNLL instead of LMHead+FusedLMHeadCriterion")
     ap.add_argument("--no-device-cache", action="store_true",
                     help="re-stack + re-transfer batches every epoch instead "
                     "of the device-resident cache (measures the host data "
@@ -84,7 +90,8 @@ def main(argv=None) -> None:
     from bigdl_tpu.utils.logger_filter import redirect_logs
 
     redirect_logs()
-    model, shape, n_class, int_vocab, seq_labels = _build_model(args.model)
+    model, shape, n_class, int_vocab, seq_labels = _build_model(
+        args.model, fused_head=not args.no_fused_head)
 
     rng = np.random.RandomState(0)
     # enough records that a K-fused window fits inside one epoch (epoch
@@ -128,8 +135,11 @@ def main(argv=None) -> None:
             cast_dtype="bfloat16" if (args.precision == "bf16"
                                       and not int_vocab) else None)
 
-    criterion = (nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
-                 if seq_labels else nn.ClassNLLCriterion())
+    if seq_labels:
+        criterion = (nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+                     if args.no_fused_head else nn.FusedLMHeadCriterion())
+    else:
+        criterion = nn.ClassNLLCriterion()
     if args.distributed:
         from bigdl_tpu.parallel import MeshTopology
         from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer

@@ -60,6 +60,10 @@ def train(argv) -> None:
                         choices=["contiguous", "zigzag"],
                         help="ring shard layout; zigzag balances causal "
                         "work across devices (ring mode only)")
+    parser.add_argument("--fusedHead", action="store_true",
+                        help="LMHead + FusedLMHeadCriterion tail: the "
+                        "(B,S,V) logits never materialise (plain data-"
+                        "parallel path only)")
     args = parser.parse_args(argv)
 
     if args.contextParallel and args.tensorParallel > 1:
@@ -78,8 +82,16 @@ def train(argv) -> None:
         seq_mode=args.contextParallel or "ring",
         seq_layout=args.ringLayout if args.contextParallel == "ring"
         else "contiguous",
-        moe_experts=args.moeExperts)
-    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        moe_experts=args.moeExperts,
+        fused_head=args.fusedHead)
+    if args.fusedHead:
+        if args.contextParallel or args.tensorParallel > 1:
+            raise SystemExit("--fusedHead composes with the plain data-"
+                             "parallel path only (the CP/TP planes shard "
+                             "the standard tail)")
+        criterion = nn.FusedLMHeadCriterion()
+    else:
+        criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
 
     if args.contextParallel:
         if args.model or args.state:

@@ -21,7 +21,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
              seq_axis: Optional[str] = None,
              seq_mode: str = "ring",
              seq_layout: str = "contiguous",
-             moe_experts: int = 0, moe_k: int = 2) -> nn.Sequential:
+             moe_experts: int = 0, moe_k: int = 2,
+             fused_head: bool = False) -> nn.Sequential:
     """Causal LM: 1-based token ids (N, T) -> log-probs (N, T, vocab).
 
     ``seq_axis="seq"`` shards every attention layer over the mesh sequence
@@ -30,15 +31,23 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
     ``seq_layout="zigzag"`` selects the balanced causal ring layout; the
     training loop must then permute the embedded sequence (and targets)
     with ``parallel.context.zigzag_permutation`` before sharding — see
-    ``apps/transformer.py --ringLayout zigzag``."""
-    return (nn.Sequential()
-            .add(nn.LookupTable(vocab_size, embed_dim))
-            .add(nn.PositionalEncoding(embed_dim, max_len, dropout))
-            .add(nn.TransformerEncoder(num_layers, embed_dim, num_heads,
-                                       ffn_dim, dropout=dropout, causal=True,
-                                       seq_axis=seq_axis, seq_mode=seq_mode,
-                                       seq_layout=seq_layout,
-                                       moe_experts=moe_experts,
-                                       moe_k=moe_k))
-            .add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size)))
+    ``apps/transformer.py --ringLayout zigzag``.
+
+    ``fused_head=True`` swaps the ``TimeDistributed(Linear) -> LogSoftMax``
+    tail for ``nn.LMHead``; train with ``nn.FusedLMHeadCriterion`` and the
+    (B, S, vocab) logits are never materialised (``ops/lm_head_ce.py``).
+    Eval/predict/generate still see log-probs (LMHead computes them in
+    eval mode); the head weight keeps Linear's (V, E) layout."""
+    m = (nn.Sequential()
+         .add(nn.LookupTable(vocab_size, embed_dim))
+         .add(nn.PositionalEncoding(embed_dim, max_len, dropout))
+         .add(nn.TransformerEncoder(num_layers, embed_dim, num_heads,
+                                    ffn_dim, dropout=dropout, causal=True,
+                                    seq_axis=seq_axis, seq_mode=seq_mode,
+                                    seq_layout=seq_layout,
+                                    moe_experts=moe_experts,
+                                    moe_k=moe_k)))
+    if fused_head:
+        return m.add(nn.LMHead(embed_dim, vocab_size))
+    return (m.add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size)))
             .add(nn.LogSoftMax()))

@@ -19,7 +19,7 @@ from bigdl_tpu.nn.criterion import (
     SoftMarginCriterion, MultiLabelSoftMarginCriterion, MultiMarginCriterion,
     MultiLabelMarginCriterion, ClassSimplexCriterion, DiceCoefficientCriterion,
     L1Cost, SoftmaxWithCriterion, ParallelCriterion, MultiCriterion,
-    CriterionTable, TimeDistributedCriterion,
+    CriterionTable, TimeDistributedCriterion, FusedLMHeadCriterion,
 )
 from bigdl_tpu.nn.activation import (
     ReLU, ReLU6, Threshold, PReLU, RReLU, LeakyReLU, ELU, Sigmoid, LogSigmoid,
@@ -29,7 +29,7 @@ from bigdl_tpu.nn.activation import (
 )
 from bigdl_tpu.nn.linear import (
     Linear, Bilinear, Cosine, Euclidean, MM, MV, DotProduct, LookupTable,
-    Add, CAdd, Mul, CMul, Scale,
+    Add, CAdd, Mul, CMul, Scale, LMHead,
 )
 from bigdl_tpu.nn.conv import (
     SpatialConvolution, SpatialShareConvolution, SpaceToDepthConv7,

@@ -520,3 +520,57 @@ class TimeDistributedCriterion(Criterion):
         y = jnp.reshape(target, (n * t,) + target.shape[2:])
         loss = self.criterion.update_output(x, y)
         return loss / t if self.size_average else loss
+
+
+class FusedLMHeadCriterion(Criterion):
+    """Chunked-vocab cross-entropy paired with ``nn.LMHead``.
+
+    Training path: ``input`` is the Table ``(hidden, weight[, bias])`` that
+    ``LMHead`` emits in training mode; the loss is computed by
+    ``ops/lm_head_ce.fused_lm_head_ce`` — an online-logsumexp scan over
+    vocab chunks whose custom VJP recomputes per chunk, so neither the
+    logits nor their cotangent ever materialise at (N, V).
+
+    Validation path: when ``input`` is a plain array it is taken as
+    LOG-PROBABILITIES over the trailing axis (LMHead's eval output) and
+    scored as mean NLL over all leading positions — so the same criterion
+    instance works inside ``optim.Loss`` during validation.
+
+    Numerically equal (to fp32 tolerance) to
+    ``TimeDistributedCriterion(ClassNLLCriterion())`` on the unfused tail
+    (the inner NLL's size-average already spans the merged batch*time axis,
+    i.e. the loss is the flat mean over every position).
+    """
+
+    def __init__(self, chunk: int = 16384, size_average: bool = True,
+                 ignore_index: Optional[int] = None):
+        super().__init__()
+        self.chunk = chunk
+        self.size_average = size_average
+        self.ignore_index = ignore_index
+
+    def update_output(self, input, target):
+        from bigdl_tpu.ops.lm_head_ce import fused_lm_head_ce
+        if isinstance(input, (Table, tuple, list)):
+            if isinstance(input, Table):
+                hidden, weight = input[1], input[2]
+                bias = input[3] if len(input) >= 3 else None
+            else:
+                hidden, weight = input[0], input[1]
+                bias = input[2] if len(input) >= 3 else None
+            return fused_lm_head_ce(hidden, weight, bias, target,
+                                    chunk=self.chunk,
+                                    size_average=self.size_average,
+                                    ignore_index=self.ignore_index)
+        # eval fallback: input already log-probs (B, S, V) or (N, V)
+        logp = input
+        tgt = target.astype(jnp.int32) - 1
+        picked = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        if self.ignore_index is not None:
+            valid = target.astype(jnp.int32) != int(self.ignore_index)
+            total = -jnp.sum(jnp.where(valid, picked, 0.0))
+            if self.size_average:
+                return total / jnp.maximum(jnp.sum(valid.astype(
+                    jnp.float32)), 1.0)
+            return total
+        return -_reduce(picked, self.size_average)

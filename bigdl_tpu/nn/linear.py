@@ -243,3 +243,66 @@ class Scale(TensorModule):
 
     def update_output(self, input):
         return self.cadd.update_output(self.cmul.update_output(input))
+
+
+class LMHead(Module):
+    """Vocabulary projection for the fused-CE language-model tail.
+
+    Replaces ``TimeDistributed(Linear(E, V)) -> LogSoftMax`` when training
+    with ``FusedLMHeadCriterion``: in TRAINING mode the output is a Table
+    ``(hidden, weight, bias)`` — the criterion computes chunked cross-entropy
+    directly from the hidden states, so the (B, S, V) logits never hit HBM
+    (``ops/lm_head_ce.py``; measured at 54% of the LM step unfused, PERF.md).
+    In EVAL mode it computes ordinary log-probabilities, so validation
+    metrics, ``predict`` and ``models.generate`` see the standard tail.
+
+    Weight layout is Linear's (V, E); note the parameter TREE path differs
+    from the unfused tail (``LMHead.weight`` vs ``TimeDistributed -> Linear
+    .weight``), so moving weights between the two tails is an array copy,
+    not a tree-structural match.
+    """
+
+    _decode = False  # class attr (pickle fwd-compat), see enable_decode
+
+    def __init__(self, input_size: int, vocab_size: int,
+                 with_bias: bool = True, w_regularizer=None,
+                 b_regularizer=None):
+        super().__init__()
+        self.input_size = input_size
+        self.vocab_size = vocab_size
+        self.with_bias = with_bias
+        self.register_parameter(
+            "weight", init.default_init((vocab_size, input_size), input_size),
+            regularizer=w_regularizer)
+        if with_bias:
+            self.register_parameter(
+                "bias", init.default_init((vocab_size,), input_size),
+                regularizer=b_regularizer)
+
+    def enable_decode(self) -> "LMHead":
+        """Incremental generation: only the LAST position's log-probs are
+        computed (sampling never reads the earlier prompt positions, and
+        the full (B, S, V) prefill array is exactly what this head exists
+        to avoid)."""
+        self._decode = True
+        return self
+
+    def disable_decode(self) -> "LMHead":
+        self._decode = False
+        return self
+
+    def update_output(self, input):
+        from bigdl_tpu.utils.table import Table
+        if self.training:
+            if self.with_bias:
+                return Table(input, self.weight, self.bias)
+            return Table(input, self.weight)
+        if self._decode:
+            input = input[:, -1:]
+        y = jnp.matmul(match_compute(input, self.weight), self.weight.T)
+        if self.with_bias:
+            y = y + self.bias
+        return jax.nn.log_softmax(y, axis=-1)
+
+    def __repr__(self):
+        return f"LMHead({self.input_size} -> {self.vocab_size})"

@@ -1,0 +1,171 @@
+"""Fused LM-head cross-entropy: value/grad parity with the unfused tail."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.models import transformer
+from bigdl_tpu.ops.lm_head_ce import fused_lm_head_ce
+
+N, E, V = 24, 16, 37  # deliberately not chunk-aligned
+
+
+def ref_ce(h, w, b, tgt, size_average=True, ignore_index=None):
+    logits = h.astype(jnp.float32) @ w.astype(jnp.float32).T
+    if b is not None:
+        logits = logits + b.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, (tgt.astype(jnp.int32) - 1)[:, None], axis=1)[:, 0]
+    if ignore_index is not None:
+        valid = tgt.astype(jnp.int32) != ignore_index
+        s = -jnp.sum(jnp.where(valid, picked, 0.0))
+        return s / jnp.sum(valid) if size_average else s
+    return -jnp.mean(picked) if size_average else -jnp.sum(picked)
+
+
+def make_inputs(seed=0, n=N):
+    rng = np.random.RandomState(seed)
+    h = jnp.asarray(rng.randn(n, E).astype(np.float32))
+    w = jnp.asarray(rng.randn(V, E).astype(np.float32) * 0.3)
+    b = jnp.asarray(rng.randn(V).astype(np.float32) * 0.1)
+    tgt = jnp.asarray(rng.randint(1, V + 1, (n,)).astype(np.float32))
+    return h, w, b, tgt
+
+
+class TestFusedOp:
+    @pytest.mark.parametrize("chunk", [7, 16, 37, 64])
+    def test_value_parity(self, chunk):
+        h, w, b, tgt = make_inputs()
+        got = fused_lm_head_ce(h, w, b, tgt, chunk=chunk)
+        want = ref_ce(h, w, b, tgt)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+    @pytest.mark.parametrize("chunk", [7, 37, 64])
+    def test_grad_parity(self, chunk):
+        h, w, b, tgt = make_inputs(1)
+        gf = jax.grad(lambda h, w, b: fused_lm_head_ce(
+            h, w, b, tgt, chunk=chunk), argnums=(0, 1, 2))(h, w, b)
+        gr = jax.grad(lambda h, w, b: ref_ce(h, w, b, tgt),
+                      argnums=(0, 1, 2))(h, w, b)
+        for a, e in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       atol=2e-5, rtol=1e-4)
+
+    def test_no_bias(self):
+        h, w, _, tgt = make_inputs(2)
+        got = fused_lm_head_ce(h, w, None, tgt, chunk=16)
+        want = ref_ce(h, w, None, tgt)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+    def test_sum_reduction(self):
+        h, w, b, tgt = make_inputs(3)
+        got = fused_lm_head_ce(h, w, b, tgt, chunk=16, size_average=False)
+        want = ref_ce(h, w, b, tgt, size_average=False)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+    def test_ignore_index(self):
+        h, w, b, tgt = make_inputs(4)
+        tgt = tgt.at[::3].set(1.0)  # mark a third of rows with target 1
+        got = fused_lm_head_ce(h, w, b, tgt, chunk=16, ignore_index=1)
+        want = ref_ce(h, w, b, tgt, ignore_index=1)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+        # ignored rows get zero hidden-gradient
+        gh = jax.grad(lambda h: fused_lm_head_ce(
+            h, w, b, tgt, chunk=16, ignore_index=1))(h)
+        assert np.abs(np.asarray(gh)[::3]).max() == 0.0
+
+    def test_3d_hidden(self):
+        h, w, b, tgt = make_inputs(5)
+        h3 = h.reshape(4, 6, E)
+        t3 = tgt.reshape(4, 6)
+        got = fused_lm_head_ce(h3, w, b, t3, chunk=16)
+        want = ref_ce(h, w, b, tgt)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+    def test_bf16_hidden_finite_and_close(self):
+        h, w, b, tgt = make_inputs(6)
+        got = fused_lm_head_ce(h.astype(jnp.bfloat16),
+                               w.astype(jnp.bfloat16), b, tgt, chunk=16)
+        want = ref_ce(h, w, b, tgt)
+        assert np.isfinite(float(got))
+        np.testing.assert_allclose(float(got), float(want), rtol=0.05)
+
+
+class TestCriterionAndHead:
+    def test_head_train_emits_table_eval_logprobs(self):
+        head = nn.LMHead(E, V)
+        h = jnp.ones((2, 3, E))
+        out = head.forward(h)
+        assert len(out) == 3  # Table(hidden, weight, bias)
+        head.evaluate_mode()
+        logp = head.forward(h)
+        assert logp.shape == (2, 3, V)
+        np.testing.assert_allclose(
+            np.asarray(jnp.exp(logp).sum(-1)), 1.0, rtol=1e-5)
+
+    def test_criterion_matches_time_distributed_nll(self):
+        rng = np.random.RandomState(7)
+        h = jnp.asarray(rng.randn(2, 5, E).astype(np.float32))
+        tgt = jnp.asarray(rng.randint(1, V + 1, (2, 5)).astype(np.float32))
+        head = nn.LMHead(E, V)
+        fused = nn.FusedLMHeadCriterion(chunk=16).apply(head.forward(h), tgt)
+        head.evaluate_mode()
+        logp = head.forward(h)
+        # default size_average=False: inner NLL already averages over the
+        # merged batch*time axis -> flat mean, which is what fused computes
+        ref = nn.TimeDistributedCriterion(
+            nn.ClassNLLCriterion()).apply(logp, tgt)
+        np.testing.assert_allclose(float(fused), float(ref), rtol=1e-5)
+        # eval fallback: same criterion instance scores log-probs directly
+        fb = nn.FusedLMHeadCriterion(chunk=16).apply(logp, tgt)
+        np.testing.assert_allclose(float(fb), float(ref), rtol=1e-5)
+
+    def test_fused_model_trains_with_loss_parity(self):
+        """One SGD step on fused vs unfused tails with identical weights
+        produces the same loss trajectory."""
+        from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+        from bigdl_tpu.optim import SGD, Optimizer, Trigger
+
+        rng = np.random.RandomState(0)
+        vocab, s = 19, 6
+        feats = [rng.randint(1, vocab + 1, (s,)).astype(np.float32)
+                 for _ in range(8)]
+        samples = [Sample(f, rng.randint(1, vocab + 1, (s,))
+                          .astype(np.float32)) for f in feats]
+
+        def run(fused):
+            from bigdl_tpu.utils.rng import manual_seed
+            manual_seed(123)  # identical shuffle order across both runs
+            m = transformer.build_lm(vocab, 8, 2, 16, num_layers=1,
+                                     max_len=16, fused_head=fused)
+            # identical init across both builds
+            from jax.flatten_util import ravel_pytree
+            seed_tree = m.parameter_tree()
+            flat, unravel = ravel_pytree(seed_tree)
+            m.load_parameter_tree(unravel(
+                jnp.asarray(np.random.RandomState(42)
+                            .randn(flat.size).astype(np.float32) * 0.1)))
+            crit = (nn.FusedLMHeadCriterion(chunk=8) if fused else
+                    nn.TimeDistributedCriterion(nn.ClassNLLCriterion()))
+            ds = DataSet.array(samples).transform(SampleToBatch(batch_size=4))
+            losses = []
+
+            class Rec:
+                def add_scalar(self, tag, v, step):
+                    if tag == "Loss":
+                        losses.append(float(v))
+
+                def get_summary_trigger(self, name):
+                    return None
+
+            opt = Optimizer(m, ds, crit)
+            opt.set_optim_method(SGD(learningrate=0.1))
+            opt.set_train_summary(Rec())
+            opt.set_end_when(Trigger.max_iteration(4))
+            opt.optimize()
+            return losses
+
+        np.testing.assert_allclose(run(True), run(False), rtol=2e-4)
