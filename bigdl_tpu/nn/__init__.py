@@ -31,6 +31,11 @@ from bigdl_tpu.nn.linear import (
     Linear, Bilinear, Cosine, Euclidean, MM, MV, DotProduct, LookupTable,
     Add, CAdd, Mul, CMul, Scale, LMHead,
 )
+from bigdl_tpu.nn.quantized import (
+    quantize_model, quantize_module, quantize_array, QuantizedLinear,
+    QuantizedLMHead, QuantizedSpatialConvolution, QuantizedMultiHeadAttention,
+    QuantizedLookupTable,
+)
 from bigdl_tpu.nn.conv import (
     SpatialConvolution, SpatialShareConvolution, SpaceToDepthConv7,
     stem_conv7, SpatialDilatedConvolution,

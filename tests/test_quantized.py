@@ -1,0 +1,111 @@
+"""Int8 weight-only quantized inference: fidelity + mechanics."""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.models import lenet, transformer
+from bigdl_tpu.models.generation import generate
+from bigdl_tpu.nn.quantized import quantize_array, quantize_model, \
+    quantize_module
+
+
+class TestQuantizeArray:
+    def test_roundtrip_error_bound(self):
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(16, 32).astype(np.float32))
+        q, s = quantize_array(w, 0)
+        assert q.dtype == jnp.int8 and s.shape == (16, 1)
+        err = np.abs(np.asarray(w) - np.asarray(q, np.float32) * np.asarray(s))
+        # symmetric rounding: error within half a quantization step per row
+        assert (err <= np.asarray(s) / 2 + 1e-7).all()
+
+    def test_channel_axis_minus_one(self):
+        w = jnp.asarray(np.random.RandomState(1).randn(3, 3, 8, 4)
+                        .astype(np.float32))
+        q, s = quantize_array(w, -1)
+        assert s.shape == (1, 1, 1, 4)
+
+
+class TestQuantizedModules:
+    def test_linear_close_to_fp32(self):
+        rng = np.random.RandomState(2)
+        lin = nn.Linear(32, 16)
+        x = jnp.asarray(rng.randn(8, 32).astype(np.float32))
+        want = np.asarray(lin.forward(x))
+        qlin = quantize_module(lin.clone_module())
+        got = np.asarray(qlin.forward(x), np.float32)
+        assert np.abs(got - want).max() < 0.15 * np.abs(want).max()
+        assert qlin.parameters() == []
+
+    def test_unsupported_type_raises(self):
+        with pytest.raises(ValueError, match="no quantized twin"):
+            quantize_module(nn.ReLU())
+
+    def test_max_norm_lookup_rejected(self):
+        with pytest.raises(ValueError, match="max-norm"):
+            quantize_module(nn.LookupTable(10, 4, max_norm=1.0))
+
+    def test_lookup_padding_value(self):
+        lt = nn.LookupTable(10, 4, padding_value=3.0)
+        qlt = quantize_module(lt.clone_module())
+        out = qlt.forward(jnp.asarray([[1.0, 3.0, 5.0]]))
+        assert np.abs(np.asarray(out)[0, 1]).max() == 0.0
+        assert np.abs(np.asarray(out)[0, 0]).max() > 0.0
+
+
+class TestQuantizedModel:
+    def test_lenet_predictions_survive(self):
+        model = lenet.build(10)
+        x = jnp.asarray(np.random.RandomState(3).rand(16, 28, 28, 1)
+                        .astype(np.float32))
+        want = np.asarray(model.predict(x))
+        qmodel = quantize_model(model)
+        got = np.asarray(qmodel.predict(x), np.float32)
+        # top-1 agreement on nearly every sample; log-probs stay close
+        agree = (got.argmax(-1) == want.argmax(-1)).mean()
+        assert agree >= 0.9
+        assert np.abs(got - want).max() < 0.5
+        # original untouched
+        assert type(model.modules()[1]).__name__ != "QuantizedSpatialConvolution"
+        assert len(model.parameters()) > 0
+        assert qmodel.parameters() == []
+
+    def test_lm_generation_runs_quantized(self):
+        model = transformer.build_lm(50, 32, 4, 64, num_layers=2, max_len=64)
+        qmodel = quantize_model(model)
+        out = generate(qmodel, jnp.asarray([[3.0, 7.0, 2.0]]), 8, greedy=True)
+        ids = np.asarray(out)
+        assert ids.shape == (1, 11)
+        assert ids.min() >= 1 and ids.max() <= 50
+        # fp32 vs int8 log-probs stay close on the prompt
+        lp = np.asarray(model.predict(jnp.ones((1, 4))), np.float32)
+        qlp = np.asarray(qmodel.predict(jnp.ones((1, 4))), np.float32)
+        assert np.abs(lp - qlp).max() < 0.5
+
+    def test_fused_head_lm_quantizes_for_eval_only(self):
+        model = transformer.build_lm(40, 16, 2, 32, num_layers=1,
+                                     max_len=32, fused_head=True)
+        qmodel = quantize_model(model)
+        logp = qmodel.predict(jnp.ones((2, 5)))
+        assert logp.shape == (2, 5, 40)
+        with pytest.raises(RuntimeError, match="inference-only"):
+            qmodel.training_mode().forward(jnp.ones((2, 5)))
+
+    def test_pickle_roundtrip(self):
+        qmodel = quantize_model(lenet.build(10))
+        x = jnp.ones((2, 28, 28, 1))
+        want = np.asarray(qmodel.predict(x))
+        clone = pickle.loads(pickle.dumps(qmodel))
+        np.testing.assert_allclose(np.asarray(clone.predict(x)), want,
+                                   rtol=1e-5)
+
+    def test_int8_storage(self):
+        qmodel = quantize_model(lenet.build(10))
+        qbufs = [b for m in qmodel.modules()
+                 for n, b in m._buffers.items() if n.endswith("_q")]
+        assert qbufs and all(b.dtype == jnp.int8 for b in qbufs)
