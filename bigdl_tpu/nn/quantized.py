@@ -17,9 +17,11 @@ quantization:
 
 ``quantize_model(model)`` deep-copies a trained model and swaps every
 supported layer (Linear, LMHead, SpatialConvolution, MultiHeadAttention
-projections, LookupTable) for its quantized twin; the original is left
-untouched, the copy is inference-only (``parameters()`` is empty — an
-Optimizer sees nothing to train).
+projections, LookupTable) for its quantized twin; remaining parametric
+layers (LayerNorm, BatchNorm, ...) have their fp32 parameters frozen
+into buffers. The original is left untouched; the copy is inference-only
+(``parameters()`` is empty across the WHOLE tree — an Optimizer sees
+nothing to train).
 """
 
 from __future__ import annotations
@@ -55,6 +57,11 @@ class _QuantizedMixin:
 
     # name -> output-channel axis of that weight
     _quant_weights: Dict[str, int] = {}
+
+    @classmethod
+    def _validate(cls, m: Module) -> None:
+        """Pre-swap check hook — runs BEFORE the class swap so a rejected
+        module is left exactly as it was."""
 
     def _quantize_in_place(self, compute_dtype):
         self.__dict__["compute_dtype"] = compute_dtype
@@ -128,6 +135,14 @@ class QuantizedLookupTable(_QuantizedMixin, LookupTable):
 
     _quant_weights = {"weight": 0}  # (vocab, dim): per-row scale
 
+    weight = property(lambda self: self._dequant("weight"))
+
+    @classmethod
+    def _validate(cls, m):
+        if m.max_norm != float("inf"):
+            raise ValueError("max-norm LookupTable cannot be quantized "
+                             "(renormalisation needs the fp32 table)")
+
     def update_output(self, input):
         q = self._buffers["weight_q"]
         scale = self._buffers["weight_scale"]
@@ -138,12 +153,6 @@ class QuantizedLookupTable(_QuantizedMixin, LookupTable):
         if self.padding_value != 0:
             out = jnp.where((input == self.padding_value)[..., None], 0.0, out)
         return out
-
-    def _quantize_in_place(self, compute_dtype):
-        if self.max_norm != float("inf"):
-            raise ValueError("max-norm LookupTable cannot be quantized "
-                             "(renormalisation needs the fp32 table)")
-        super()._quantize_in_place(compute_dtype)
 
 
 _REGISTRY: Dict[Type[Module], Type[Module]] = {
@@ -160,6 +169,7 @@ def quantize_module(m: Module, compute_dtype=jnp.bfloat16) -> Module:
     qcls = _REGISTRY.get(type(m))
     if qcls is None:
         raise ValueError(f"no quantized twin for {type(m).__name__}")
+    qcls._validate(m)  # reject BEFORE mutating: failure leaves m untouched
     m.__class__ = qcls
     m._quantize_in_place(compute_dtype)
     return m
@@ -180,4 +190,10 @@ def quantize_model(model: Module, compute_dtype=jnp.bfloat16) -> Module:
                 quantize_module(child, compute_dtype)
     if type(qmodel) in _REGISTRY:
         quantize_module(qmodel, compute_dtype)
+    # freeze whatever parametric layers remain (norms etc.): fp32 params
+    # become buffers, so the whole tree is optimizer-invisible
+    for m in qmodel.modules():
+        for name in list(m._parameters):
+            m.register_buffer(name, m._parameters.pop(name))
+        m._param_regularizers.clear()
     return qmodel.evaluate_mode()

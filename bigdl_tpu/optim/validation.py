@@ -152,3 +152,50 @@ class Loss(ValidationMethod):
 
     def to_result(self, value, count):
         return LossResult(value, count)
+
+
+class PerplexityResult(ValidationResult):
+    """(sum NLL over tokens, token count): result = exp(mean NLL)."""
+
+    def __init__(self, nll: float, count: int):
+        self.nll, self.count = float(nll), int(count)
+
+    def state(self):
+        return (self.nll, float(self.count))
+
+    def result(self):
+        import math
+        return (math.exp(self.nll / max(1, self.count)), self.count)
+
+    def __add__(self, other: "PerplexityResult"):
+        return PerplexityResult(self.nll + other.nll, self.count + other.count)
+
+    def __repr__(self):
+        ppl, n = self.result()
+        return f"Perplexity(tokens: {n}, ppl: {ppl:.4f})"
+
+
+class Perplexity(ValidationMethod):
+    """LM perplexity: exp of the mean per-token NLL — the standard LM eval
+    metric, paired with the causal-LM workload (no reference analogue; the
+    reference predates LMs). ``output`` is (B, S, V) LOG-PROBS (the LM's
+    eval-mode output, unfused or ``LMHead``); ``target`` is (B, S) 1-based
+    token ids. Tokens equal to ``ignore_index`` (e.g. padding) are skipped.
+    """
+
+    name = "Perplexity"
+
+    def __init__(self, ignore_index: Optional[int] = None):
+        self.ignore_index = ignore_index
+
+    def batch_result(self, output, target):
+        tgt = target.astype(jnp.int32)
+        picked = jnp.take_along_axis(output, (tgt - 1)[..., None],
+                                     axis=-1)[..., 0]
+        if self.ignore_index is not None:
+            valid = tgt != int(self.ignore_index)
+            return -jnp.sum(jnp.where(valid, picked, 0.0)), jnp.sum(valid)
+        return -jnp.sum(picked), picked.size
+
+    def to_result(self, value, count):
+        return PerplexityResult(value, count)

@@ -183,3 +183,40 @@ class TestDecodeInternals:
         assert td.forward(h).shape == (2, 5, 30)
         td.enable_decode()
         assert td.forward(h).shape == (2, 1, 30)
+
+
+class TestPerplexity:
+    def test_uniform_model_ppl_is_vocab(self):
+        from bigdl_tpu.optim.validation import Perplexity
+        logp = jnp.full((2, 6, 40), -jnp.log(40.0))
+        tgt = jnp.ones((2, 6))
+        r = Perplexity().apply(logp, tgt)
+        ppl, n = r.result()
+        assert n == 12
+        np.testing.assert_allclose(ppl, 40.0, rtol=1e-5)
+
+    def test_ignore_index_and_merge(self):
+        from bigdl_tpu.optim.validation import Perplexity
+        logp = jnp.log(jnp.full((1, 4, 10), 0.1))
+        tgt = jnp.asarray([[1.0, 2.0, 7.0, 7.0]])
+        m = Perplexity(ignore_index=7)
+        r = m.apply(logp, tgt)
+        assert r.result()[1] == 2
+        merged = r + m.apply(logp, tgt)
+        ppl, n = merged.result()
+        assert n == 4
+        np.testing.assert_allclose(ppl, 10.0, rtol=1e-5)
+
+    def test_evaluate_lm_end_to_end(self):
+        from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+        from bigdl_tpu.optim.validation import Perplexity
+        rng = np.random.RandomState(0)
+        model = tiny_lm()
+        samples = [Sample(rng.randint(1, VOCAB + 1, (10,)).astype(np.float32),
+                          rng.randint(1, VOCAB + 1, (10,)).astype(np.float32))
+                   for _ in range(8)]
+        ds = DataSet.array(samples).transform(SampleToBatch(batch_size=4))
+        (res, method), = model.evaluate(ds, [Perplexity()])
+        ppl, n = res.result()
+        assert n == 80
+        assert 1.0 < ppl < 10 * VOCAB  # finite, sane range

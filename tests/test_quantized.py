@@ -46,9 +46,22 @@ class TestQuantizedModules:
         with pytest.raises(ValueError, match="no quantized twin"):
             quantize_module(nn.ReLU())
 
-    def test_max_norm_lookup_rejected(self):
+    def test_max_norm_lookup_rejected_and_untouched(self):
+        lt = nn.LookupTable(10, 4, max_norm=1.0)
         with pytest.raises(ValueError, match="max-norm"):
-            quantize_module(nn.LookupTable(10, 4, max_norm=1.0))
+            quantize_module(lt)
+        # rejection leaves the module exactly as it was (class + params)
+        assert type(lt) is nn.LookupTable
+        assert "weight" in lt._parameters
+        lt.forward(jnp.asarray([[1.0, 2.0]]))
+
+    def test_lookup_weight_property_dequantizes(self):
+        lt = nn.LookupTable(10, 4)
+        want = np.asarray(lt.weight)
+        qlt = quantize_module(lt.clone_module())
+        got = np.asarray(qlt.weight, np.float32)
+        assert got.shape == (10, 4)
+        assert np.abs(got - want).max() < 0.05 * np.abs(want).max() + 1e-3
 
     def test_lookup_padding_value(self):
         lt = nn.LookupTable(10, 4, padding_value=3.0)
@@ -82,6 +95,8 @@ class TestQuantizedModel:
         ids = np.asarray(out)
         assert ids.shape == (1, 11)
         assert ids.min() >= 1 and ids.max() <= 50
+        # the WHOLE tree is optimizer-invisible (norm params frozen too)
+        assert qmodel.parameters() == []
         # fp32 vs int8 log-probs stay close on the prompt
         lp = np.asarray(model.predict(jnp.ones((1, 4))), np.float32)
         qlp = np.asarray(qmodel.predict(jnp.ones((1, 4))), np.float32)
