@@ -124,10 +124,108 @@ def _build_decode_fn(model: Module, max_new_tokens: int, temperature: float,
     return jax.jit(run)
 
 
+def _map_cache_leaves(buffers, fn):
+    """Apply fn to every KV-cache leaf (k_cache/v_cache) in a buffer tree."""
+    import jax.tree_util as jtu
+
+    def visit(path, leaf):
+        key = str(path[-1].key) if path and hasattr(path[-1], "key") else ""
+        return fn(leaf) if key in ("k_cache", "v_cache") else leaf
+
+    return jtu.tree_map_with_path(visit, buffers)
+
+
+def _build_beam_fn(model: Module, max_new_tokens: int, num_beams: int,
+                   length_penalty: float, eos_id: Optional[int], pad_id: int):
+    """Pure (params, buffers, prompt) -> (B, S0+max_new) best-beam ids.
+
+    Standard batched beam search over the KV cache: prefill once at batch
+    B, tile the caches to B*num_beams, then each scan step scores all
+    (beam, token) continuations, keeps the top ``num_beams`` per batch
+    item, and REORDERS the caches by each survivor's parent beam (a
+    take-along-batch gather applied to every cache leaf). Finished beams
+    (emitted ``eos_id``) are frozen: their only continuation is ``pad_id``
+    at unchanged score. The returned sequence is the best beam under
+    GNMT-style length normalisation ``score / len(tokens)**length_penalty``.
+    """
+    n = num_beams
+
+    def run(params, buffers, prompt):
+        b, s0 = prompt.shape
+        out, bufs = functional_apply(model, params, buffers, prompt,
+                                     training=False)
+        logp0 = out[:, -1].astype(jnp.float32)              # (B, V)
+        v = logp0.shape[-1]
+        if eos_id is not None and not 1 <= pad_id <= v:
+            raise ValueError(
+                f"pad_id {pad_id} outside the vocab 1..{v}: frozen beams "
+                "continue with pad_id, so it must be a real token id")
+        # initial beams: top-n first tokens (filler beams at -inf when the
+        # vocab is smaller than the beam width)
+        k0 = min(n, v)
+        scores0, idx = jax.lax.top_k(logp0, k0)             # (B, k0)
+        if k0 < n:
+            scores0 = jnp.pad(scores0, ((0, 0), (0, n - k0)),
+                              constant_values=-jnp.inf)
+            idx = jnp.pad(idx, ((0, 0), (0, n - k0)),
+                          constant_values=pad_id - 1)
+        scores = scores0
+        tok = (idx + 1).astype(jnp.int32)
+        done = (tok == eos_id) if eos_id is not None else jnp.zeros(
+            tok.shape, bool)
+        if k0 < n:  # filler beams are frozen from the start
+            done = done | (jnp.arange(n)[None, :] >= k0)
+        lengths = jnp.ones(tok.shape, jnp.float32)
+        # tile caches to B*n (batch-major: beams of item i are contiguous)
+        bufs = _map_cache_leaves(bufs, lambda x: jnp.repeat(x, n, axis=0))
+        seqs = jnp.zeros((b, n, max_new_tokens), jnp.int32)
+        seqs = seqs.at[:, :, 0].set(tok)
+
+        def body(carry, t):
+            bufs, tok, scores, done, lengths, seqs = carry
+            step_in = tok.reshape(b * n, 1).astype(prompt.dtype)
+            out, bufs = functional_apply(model, params, bufs, step_in,
+                                         training=False)
+            logp = out[:, -1].astype(jnp.float32).reshape(b, n, v)
+            if eos_id is not None:
+                # frozen beams may only emit pad at unchanged score
+                frozen = jnp.full((v,), -jnp.inf).at[pad_id - 1].set(0.0)
+                logp = jnp.where(done[..., None], frozen, logp)
+            total = scores[..., None] + logp                # (B, n, V)
+            scores, flat_idx = jax.lax.top_k(total.reshape(b, n * v), n)
+            parent = flat_idx // v                          # (B, n)
+            tok = (flat_idx % v + 1).astype(jnp.int32)
+            take = lambda arr: jnp.take_along_axis(arr, parent, axis=1)
+            done = take(done)
+            lengths = take(lengths) + jnp.where(done, 0.0, 1.0)
+            seqs = jnp.take_along_axis(seqs, parent[..., None], axis=1)
+            seqs = seqs.at[:, :, t].set(jnp.where(done, pad_id, tok))
+            if eos_id is not None:
+                done = done | (tok == eos_id)
+            flat_parent = (jnp.arange(b)[:, None] * n + parent).reshape(-1)
+            bufs = _map_cache_leaves(
+                bufs, lambda x: jnp.take(x, flat_parent, axis=0))
+            return (bufs, tok, scores, done, lengths, seqs), None
+
+        if max_new_tokens > 1:
+            (bufs, tok, scores, done, lengths, seqs), _ = jax.lax.scan(
+                body, (bufs, tok, scores, done, lengths, seqs),
+                jnp.arange(1, max_new_tokens))
+        norm = scores / jnp.power(jnp.maximum(lengths, 1.0), length_penalty)
+        best = jnp.argmax(norm, axis=1)                     # (B,)
+        best_seq = jnp.take_along_axis(
+            seqs, best[:, None, None], axis=1)[:, 0]        # (B, max_new)
+        return jnp.concatenate(
+            [prompt, best_seq.astype(prompt.dtype)], axis=1)
+
+    return jax.jit(run)
+
+
 def generate(model: Module, prompt, max_new_tokens: int, *,
              temperature: float = 1.0, top_k: int = 0, top_p: float = 0.0,
              greedy: bool = False, eos_id: Optional[int] = None,
              pad_id: Optional[int] = None,
+             num_beams: int = 0, length_penalty: float = 1.0,
              key: Optional[jax.Array] = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -137,11 +235,19 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
     (default: ``eos_id``). Sampling is greedy when ``greedy`` or
     ``temperature + filters`` select it deterministically; otherwise draws
     use ``key`` (default PRNGKey(0) — pass your own for varied samples).
+    ``num_beams > 1`` switches to deterministic beam search (per-batch-item
+    beams over the KV cache, GNMT length penalty) — incompatible with the
+    stochastic ``top_k``/``top_p`` filters.
 
     The whole decode — prompt prefill, per-token steps, sampling — is one
     jitted program per (shape, sampling-config); compiled programs are
     cached on the model instance.
     """
+    if num_beams > 1 and (top_k or top_p):
+        raise ValueError("beam search is deterministic; top_k/top_p do not "
+                         "compose with num_beams")
+    if num_beams == 1:
+        greedy = True  # width-1 beam search IS greedy decoding
     prompt = jnp.asarray(prompt)
     squeeze = prompt.ndim == 1
     if squeeze:
@@ -169,15 +275,23 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
         params, buffers = model.functional_state()
         cache = model.__dict__.setdefault("_generate_fns", {})
         sig = (b, s0, max_new_tokens, float(temperature), int(top_k),
-               float(top_p), bool(greedy), eos_id, pad_id)
+               float(top_p), bool(greedy), eos_id, pad_id,
+               int(num_beams), float(length_penalty))
         fn = cache.get(sig)
         if fn is None:
-            fn = _build_decode_fn(model, max_new_tokens, temperature, top_k,
-                                  top_p, greedy, eos_id, pad_id)
+            if num_beams > 1:
+                fn = _build_beam_fn(model, max_new_tokens, num_beams,
+                                    length_penalty, eos_id, pad_id)
+            else:
+                fn = _build_decode_fn(model, max_new_tokens, temperature,
+                                      top_k, top_p, greedy, eos_id, pad_id)
             cache[sig] = fn
-        if key is None:
-            key = jax.random.PRNGKey(0)
-        out = fn(params, buffers, prompt, key)
+        if num_beams > 1:
+            out = fn(params, buffers, prompt)
+        else:
+            if key is None:
+                key = jax.random.PRNGKey(0)
+            out = fn(params, buffers, prompt, key)
     finally:
         for m in mhas + pes + heads:
             m.disable_decode()

@@ -78,9 +78,10 @@ class MultiHeadAttention(Module):
     q;k;v, each (E, E)) so oracle tests and weight import line up.
     """
 
-    # class attribute (not set in __init__) so checkpoints pickled before
+    # class attributes (not set in __init__) so checkpoints pickled before
     # decode mode existed still forward correctly after load
     _decode = False
+    _decode_prefilled = False
 
     def __init__(self, embed_dim: int, num_heads: int,
                  dropout: float = 0.0, with_bias: bool = True,
@@ -135,6 +136,7 @@ class MultiHeadAttention(Module):
         dt = self.in_proj_weight.dtype
         shape = (batch_size, max_len, self.num_heads, self.head_dim)
         self._decode = True
+        self._decode_prefilled = False
         self.register_buffer("k_cache", jnp.zeros(shape, dt))
         self.register_buffer("v_cache", jnp.zeros(shape, dt))
         self.register_buffer("decode_pos", jnp.zeros((), jnp.int32))
@@ -147,11 +149,15 @@ class MultiHeadAttention(Module):
         return self
 
     def _attend_decode(self, q, k, v):
-        """Append k/v at ``decode_pos`` and attend q against the cache.
+        """Append k/v at ``decode_pos`` and attend the new queries.
 
-        Works for both the prompt prefill (S > 1 at pos 0) and the one-token
-        steady state (S = 1); causality across the cache is a position mask
-        ``k_pos <= q_pos`` so stale tail entries never attend."""
+        Multi-token calls are the PROMPT PREFILL (``generate`` only ever
+        issues one, at position 0): the cache is cold, so the valid keys
+        are exactly the fresh k/v — attention runs through the standard
+        causal path (``_attend``), which keeps the flash-kernel dispatch
+        for long prompts and avoids materialising an (S, max_len) mask.
+        Single-token steady-state calls attend against the whole cache
+        with the position mask ``k_pos <= q_pos``."""
         from bigdl_tpu.ops import attention_core
         pos = self.decode_pos
         self.k_cache = jax.lax.dynamic_update_slice(
@@ -160,6 +166,14 @@ class MultiHeadAttention(Module):
             self.v_cache, v.astype(self.v_cache.dtype), (0, pos, 0, 0))
         s = q.shape[1]
         self.decode_pos = pos + s
+        if s > 1:  # prefill: cache was cold, fresh k/v are the whole context
+            if self._decode_prefilled:
+                raise RuntimeError(
+                    "chunked prefill is not supported: a second multi-token "
+                    "forward in decode mode would ignore the cached context "
+                    "(re-enable_decode and prefill the full prompt at once)")
+            self._decode_prefilled = True
+            return self._attend(q, k, v, None)
         k_pos = jnp.arange(self.k_cache.shape[1])[None, :]
         q_pos = pos + jnp.arange(s)[:, None]
         return attention_core.dot_product_attention(

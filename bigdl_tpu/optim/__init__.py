@@ -8,7 +8,7 @@ from bigdl_tpu.optim.methods import (
 from bigdl_tpu.optim.triggers import Trigger
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, AccuracyResult, LossResult,
-    Top1Accuracy, Top5Accuracy, Loss, Perplexity,
+    PerplexityResult, Top1Accuracy, Top5Accuracy, Loss, Perplexity,
 )
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer

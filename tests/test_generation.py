@@ -220,3 +220,94 @@ class TestPerplexity:
         ppl, n = res.result()
         assert n == 80
         assert 1.0 < ppl < 10 * VOCAB  # finite, sane range
+
+
+class TestBeamSearch:
+    def test_beam_at_least_as_good_as_greedy(self):
+        """The best beam's joint log-prob must be >= the greedy path's
+        (greedy is one of the paths beam search dominates)."""
+        model = tiny_lm()
+        p = jnp.array([[3.0, 9.0, 4.0]])
+        greedy = generate(model, p, 6, greedy=True)
+        beam = generate(model, p, 6, num_beams=4, length_penalty=0.0)
+
+        def joint_logp(seq):
+            logp = model.predict(seq)  # (1, T, V) log-probs
+            return sum(float(logp[0, t - 1, int(seq[0, t]) - 1])
+                       for t in range(3, seq.shape[1]))
+
+        assert joint_logp(beam) >= joint_logp(greedy) - 1e-4
+
+    def test_exhaustive_oracle_tiny(self):
+        """With num_beams = V and 2 steps, beam search IS exhaustive (all V
+        first tokens kept, all V^2 continuations scored): it must find the
+        argmax joint-log-prob continuation."""
+        model = transformer.build_lm(7, 16, 2, 32, num_layers=1, max_len=16)
+        p = jnp.array([[2.0, 5.0]])
+        got = generate(model, p, 2, num_beams=7, length_penalty=0.0)
+
+        best, best_s = None, -np.inf
+        for a in range(1, 8):
+            for bt in range(1, 8):
+                seq = jnp.asarray([[2.0, 5.0, float(a), float(bt)]])
+                logp = model.predict(seq)
+                s = float(logp[0, 1, a - 1]) + float(logp[0, 2, bt - 1])
+                if s > best_s:
+                    best_s, best = s, (a, bt)
+        assert tuple(np.asarray(got)[0, 2:].astype(int)) == best
+
+    def test_beam_eos_freezes(self):
+        model = tiny_lm()
+        probe = generate(model, jnp.ones((1, 2)), 5, num_beams=3)
+        eos = int(np.asarray(probe)[0, 2])
+        out = np.asarray(generate(model, jnp.ones((1, 2)), 5, num_beams=3,
+                                  eos_id=eos, pad_id=1))
+        if out[0, 2] == eos:  # best beam may legitimately avoid eos
+            assert (out[0, 3:] == 1).all()
+
+    def test_beam_batch_and_shapes(self):
+        model = tiny_lm()
+        p = jnp.array([[3.0, 9.0], [1.0, 2.0]])
+        out = generate(model, p, 7, num_beams=4)
+        assert out.shape == (2, 9)
+        ids = np.asarray(out)
+        assert ids.min() >= 1 and ids.max() <= VOCAB
+
+    def test_beam_width_exceeding_vocab(self):
+        model = transformer.build_lm(5, 16, 2, 32, num_layers=1, max_len=16)
+        out = generate(model, jnp.ones((1, 2)), 3, num_beams=9)
+        ids = np.asarray(out)
+        assert ids.shape == (1, 5)
+        assert ids.min() >= 1 and ids.max() <= 5
+
+    def test_beam_rejects_samplers(self):
+        model = tiny_lm()
+        with pytest.raises(ValueError, match="beam"):
+            generate(model, jnp.ones((1, 2)), 3, num_beams=2, top_k=5)
+
+
+class TestDecodeGuards:
+    def test_chunked_prefill_rejected(self):
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        m = MultiHeadAttention(16, 2, causal=True).evaluate_mode()
+        m.enable_decode(1, 16)
+        m.forward(jnp.ones((1, 4, 16)))  # prefill OK
+        m.forward(jnp.ones((1, 1, 16)))  # steady state OK
+        with pytest.raises(RuntimeError, match="chunked prefill"):
+            m.forward(jnp.ones((1, 4, 16)))  # second multi-token: rejected
+        m.disable_decode()
+
+    def test_num_beams_1_is_deterministic(self):
+        model = tiny_lm()
+        p = jnp.ones((1, 3))
+        a = generate(model, p, 8, num_beams=1, key=jax.random.PRNGKey(0))
+        b = generate(model, p, 8, num_beams=1, key=jax.random.PRNGKey(9))
+        g = generate(model, p, 8, greedy=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(g))
+
+    def test_beam_pad_id_out_of_vocab_rejected(self):
+        model = tiny_lm()
+        with pytest.raises(ValueError, match="pad_id"):
+            generate(model, jnp.ones((1, 2)), 3, num_beams=2, eos_id=5,
+                     pad_id=0)
