@@ -1,8 +1,10 @@
-"""Causal-LM train main for the long-context transformer stack (new
-capability; CLI shape mirrors the other ``Train.scala``-style mains).
+"""Causal-LM train + generate mains for the long-context transformer stack
+(new capability; CLI shape mirrors the other ``Train.scala``-style mains).
 
     python -m bigdl_tpu.apps.transformer train -b 8 --seqLen 256 -e 2
     python -m bigdl_tpu.apps.transformer train --contextParallel ring
+    python -m bigdl_tpu.apps.transformer generate --model ckpt.bigdl \
+        --prompt 3,5,7 --maxNewTokens 32 --topK 40
 
 ``--contextParallel`` shards the sequence axis of every attention layer over
 the mesh (ring attention or Ulysses) — the exact capability SURVEY §5.7
@@ -39,7 +41,7 @@ def _synthetic_corpus(n: int, seq_len: int, vocab: int, seed: int = 17):
     return samples
 
 
-def train(argv) -> None:
+def train(argv):
     parser = train_parser("bigdl_tpu.apps.transformer train",
                           default_batch=8, default_epochs=2, default_lr=3e-3)
     parser.add_argument("--seqLen", type=int, default=128)
@@ -121,6 +123,7 @@ def train(argv) -> None:
         trained = opt.optimize()
     if args.checkpoint:
         file_io.save(trained, f"{args.checkpoint}/model_final")
+    return trained
 
 
 def _train_context_parallel(model, criterion, ds, args):
@@ -221,11 +224,64 @@ def _train_context_parallel(model, criterion, ds, args):
     return model
 
 
+def generate_cmd(argv) -> None:
+    """Sample from a trained (or fresh synthetic-grammar) causal LM."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="bigdl_tpu.apps.transformer generate")
+    ap.add_argument("--model", default=None,
+                    help="saved model path (file_io); default: train a "
+                    "fresh tiny LM on the synthetic grammar first")
+    ap.add_argument("--prompt", default="1,2,3",
+                    help="comma-separated 1-based token ids")
+    ap.add_argument("--maxNewTokens", type=int, default=32)
+    ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--topK", type=int, default=0)
+    ap.add_argument("--topP", type=float, default=0.0)
+    ap.add_argument("--greedy", action="store_true")
+    ap.add_argument("--numBeams", type=int, default=0)
+    ap.add_argument("--lengthPenalty", type=float, default=1.0)
+    ap.add_argument("--eosId", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--int8", action="store_true",
+                    help="decode with the int8 weight-only quantized twin")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.generation import generate
+
+    if args.model:
+        model = file_io.load(args.model)
+    else:
+        print("no --model given: training a tiny LM on the synthetic "
+              "grammar first", file=sys.stderr)
+        model = train(["-b", "8", "--seqLen", "32", "--maxEpoch", "1"])
+    if args.int8:
+        model = nn.quantize_model(model)
+    prompt = jnp.asarray([[float(t) for t in args.prompt.split(",")]])
+    out = generate(model, prompt, args.maxNewTokens,
+                   temperature=args.temperature, top_k=args.topK,
+                   top_p=args.topP, greedy=args.greedy,
+                   num_beams=args.numBeams,
+                   length_penalty=args.lengthPenalty, eos_id=args.eosId,
+                   key=jax.random.PRNGKey(args.seed))
+    ids = [int(t) for t in out[0]]
+    n0 = prompt.shape[1]
+    print("prompt:      ", ids[:n0])
+    print("continuation:", ids[n0:])
+
+
 def main() -> None:
-    if len(sys.argv) < 2 or sys.argv[1] != "train":
+    if len(sys.argv) < 2 or sys.argv[1] not in ("train", "generate"):
         raise SystemExit(
-            "usage: python -m bigdl_tpu.apps.transformer train ...")
-    train(sys.argv[2:])
+            "usage: python -m bigdl_tpu.apps.transformer {train|generate} ...")
+    if sys.argv[1] == "generate":
+        generate_cmd(sys.argv[2:])
+    else:
+        train(sys.argv[2:])
 
 
 if __name__ == "__main__":

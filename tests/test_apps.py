@@ -187,6 +187,24 @@ class TestPerfHarness:
         transformer.train(["-b", "8", "--seqLen", "32", "-e", "1",
                            "--synthetic-size", "16", "--moeExperts", "4"])
 
+    def test_transformer_generate_subcommand(self, tmp_path, capsys):
+        from bigdl_tpu.apps import transformer
+        ck = str(tmp_path / "ck")
+        transformer.train(["-b", "8", "--seqLen", "16", "-e", "1",
+                           "--vocab", "32", "--synthetic-size", "16",
+                           "--checkpoint", ck])
+        transformer.generate_cmd(["--model", f"{ck}/model_final",
+                                  "--prompt", "3,5,7",
+                                  "--maxNewTokens", "6", "--greedy"])
+        out = capsys.readouterr().out
+        assert "prompt:       [3, 5, 7]" in out
+        assert "continuation:" in out
+        # beam + int8 paths through the same CLI
+        transformer.generate_cmd(["--model", f"{ck}/model_final",
+                                  "--prompt", "3,5,7", "--maxNewTokens", "4",
+                                  "--numBeams", "3", "--int8"])
+        assert "continuation:" in capsys.readouterr().out
+
     def test_context_parallel_matches_sequential_loss(self):
         # PE offsets + pmean correctness: first-step loss of the seq-parallel
         # path must equal the plain path on the same weights and batch
