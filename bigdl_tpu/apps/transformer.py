@@ -268,7 +268,7 @@ def generate_cmd(argv) -> None:
                    num_beams=args.numBeams,
                    length_penalty=args.lengthPenalty, eos_id=args.eosId,
                    key=jax.random.PRNGKey(args.seed))
-    ids = [int(t) for t in out[0]]
+    ids = np.asarray(out[0]).astype(int).tolist()  # one host transfer
     n0 = prompt.shape[1]
     print("prompt:      ", ids[:n0])
     print("continuation:", ids[n0:])

@@ -124,13 +124,16 @@ def _build_decode_fn(model: Module, max_new_tokens: int, temperature: float,
     return jax.jit(run)
 
 
-def _map_cache_leaves(buffers, fn):
-    """Apply fn to every KV-cache leaf (k_cache/v_cache) in a buffer tree."""
+def _map_cache_leaves(buffers, fn, other_fn=None):
+    """Apply ``fn`` to every KV-cache leaf (k_cache/v_cache) in a buffer
+    tree, and ``other_fn`` (default: identity) to every other leaf."""
     import jax.tree_util as jtu
 
     def visit(path, leaf):
         key = str(path[-1].key) if path and hasattr(path[-1], "key") else ""
-        return fn(leaf) if key in ("k_cache", "v_cache") else leaf
+        if key in ("k_cache", "v_cache"):
+            return fn(leaf)
+        return leaf if other_fn is None else other_fn(leaf)
 
     return jtu.tree_map_with_path(visit, buffers)
 
@@ -226,6 +229,7 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
              greedy: bool = False, eos_id: Optional[int] = None,
              pad_id: Optional[int] = None,
              num_beams: int = 0, length_penalty: float = 1.0,
+             mesh=None, data_axis: str = "data",
              key: Optional[jax.Array] = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -238,6 +242,12 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
     ``num_beams > 1`` switches to deterministic beam search (per-batch-item
     beams over the KV cache, GNMT length penalty) — incompatible with the
     stochastic ``top_k``/``top_p`` filters.
+
+    ``mesh``: a ``jax.sharding.Mesh`` for DATA-PARALLEL decoding — the
+    prompt and every KV-cache buffer shard over ``data_axis`` (the axis
+    size must divide the batch), parameters replicate, and GSPMD propagates the
+    layout through the whole prefill+scan program; decoding is
+    embarrassingly parallel over the batch, so no collectives appear.
 
     The whole decode — prompt prefill, per-token steps, sampling — is one
     jitted program per (shape, sampling-config); compiled programs are
@@ -273,7 +283,23 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
         for m in pes + heads:
             m.enable_decode()
         params, buffers = model.functional_state()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            axis = mesh.shape[data_axis]
+            if b % axis != 0:
+                raise ValueError(
+                    f"batch {b} is not a multiple of the mesh "
+                    f"'{data_axis}' axis size {axis}")
+            repl = NamedSharding(mesh, PartitionSpec())
+            row = NamedSharding(mesh, PartitionSpec(data_axis))
+            params = jax.device_put(params, repl)
+            buffers = _map_cache_leaves(
+                buffers, lambda x: jax.device_put(x, row),
+                other_fn=lambda x: jax.device_put(x, repl))
+            prompt = jax.device_put(prompt, row)
         cache = model.__dict__.setdefault("_generate_fns", {})
+        # NOTE: mesh is intentionally NOT in the key — the built fn is
+        # mesh-agnostic, and jax.jit already specialises per input sharding
         sig = (b, s0, max_new_tokens, float(temperature), int(top_k),
                float(top_p), bool(greedy), eos_id, pad_id,
                int(num_beams), float(length_penalty))

@@ -311,3 +311,33 @@ class TestDecodeGuards:
         with pytest.raises(ValueError, match="pad_id"):
             generate(model, jnp.ones((1, 2)), 3, num_beams=2, eos_id=5,
                      pad_id=0)
+
+
+class TestDataParallelDecode:
+    def test_mesh_sharded_matches_single_device(self):
+        import jax
+        from jax.sharding import Mesh
+        model = tiny_lm()
+        p = jnp.asarray(np.random.RandomState(5)
+                        .randint(1, VOCAB + 1, (8, 4)).astype(np.float32))
+        want = generate(model, p, 6, greedy=True)
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        got = generate(model, p, 6, greedy=True, mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_mesh_beam_runs(self):
+        import jax
+        from jax.sharding import Mesh
+        model = tiny_lm()
+        p = jnp.ones((8, 3))
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        out = generate(model, p, 5, num_beams=3, mesh=mesh)
+        assert out.shape == (8, 8)
+
+    def test_mesh_indivisible_batch_rejected(self):
+        import jax
+        from jax.sharding import Mesh
+        model = tiny_lm()
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        with pytest.raises(ValueError, match="multiple"):
+            generate(model, jnp.ones((3, 2)), 2, greedy=True, mesh=mesh)
