@@ -230,6 +230,7 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
              pad_id: Optional[int] = None,
              num_beams: int = 0, length_penalty: float = 1.0,
              mesh=None, data_axis: str = "data",
+             tensor_axis: Optional[str] = None,
              key: Optional[jax.Array] = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -243,11 +244,14 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
     beams over the KV cache, GNMT length penalty) — incompatible with the
     stochastic ``top_k``/``top_p`` filters.
 
-    ``mesh``: a ``jax.sharding.Mesh`` for DATA-PARALLEL decoding — the
+    ``mesh``: a ``jax.sharding.Mesh`` for distributed decoding — the
     prompt and every KV-cache buffer shard over ``data_axis`` (the axis
-    size must divide the batch), parameters replicate, and GSPMD propagates the
-    layout through the whole prefill+scan program; decoding is
-    embarrassingly parallel over the batch, so no collectives appear.
+    size must divide the batch) and GSPMD propagates the layout through
+    the whole prefill+scan program. Parameters replicate by default
+    (embarrassingly parallel — no collectives); with ``tensor_axis`` set,
+    weights additionally shard Megatron-style over that mesh axis
+    (``parallel.tensor_parallel.infer_param_specs``) for models too large
+    to replicate per device — GSPMD inserts the per-layer collectives.
 
     The whole decode — prompt prefill, per-token steps, sampling — is one
     jitted program per (shape, sampling-config); compiled programs are
@@ -285,16 +289,49 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
         params, buffers = model.functional_state()
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
-            axis = mesh.shape[data_axis]
-            if b % axis != 0:
-                raise ValueError(
-                    f"batch {b} is not a multiple of the mesh "
-                    f"'{data_axis}' axis size {axis}")
+            if tensor_axis is not None and tensor_axis not in mesh.shape:
+                raise ValueError(f"tensor_axis {tensor_axis!r} is not a "
+                                 f"mesh axis (mesh has {list(mesh.shape)})")
+            if data_axis not in mesh.shape:
+                if tensor_axis is None:
+                    raise ValueError(
+                        f"mesh has no {data_axis!r} axis (axes: "
+                        f"{list(mesh.shape)}); pass data_axis=, or "
+                        "tensor_axis= for weight-only sharding")
+                batch_dim = None  # pure TP: batch replicated
+            else:
+                batch_dim = data_axis
+                axis = mesh.shape[data_axis]
+                if b % axis != 0:
+                    raise ValueError(
+                        f"batch {b} is not a multiple of the mesh "
+                        f"'{data_axis}' axis size {axis}")
             repl = NamedSharding(mesh, PartitionSpec())
-            row = NamedSharding(mesh, PartitionSpec(data_axis))
-            params = jax.device_put(params, repl)
+            row = NamedSharding(mesh, PartitionSpec(batch_dim))
+            if tensor_axis is not None:
+                from bigdl_tpu.parallel.tensor_parallel import \
+                    infer_param_specs
+                specs = infer_param_specs(model, axis=tensor_axis,
+                                          axis_size=dict(mesh.shape))
+                params = jax.tree_util.tree_map(
+                    lambda p, sp: jax.device_put(p, NamedSharding(mesh, sp)),
+                    params, specs)
+            else:
+                params = jax.device_put(params, repl)
+
+            def place_cache(x):
+                # (B, L, H, Dh): batch over data; heads over tensor when
+                # divisible — TP exists for memory headroom, and the KV
+                # cache is the long-context memory hog
+                head_dim = (tensor_axis if tensor_axis is not None
+                            and x.ndim == 4
+                            and x.shape[2] % mesh.shape[tensor_axis] == 0
+                            else None)
+                return jax.device_put(x, NamedSharding(
+                    mesh, PartitionSpec(batch_dim, None, head_dim)))
+
             buffers = _map_cache_leaves(
-                buffers, lambda x: jax.device_put(x, row),
+                buffers, place_cache,
                 other_fn=lambda x: jax.device_put(x, repl))
             prompt = jax.device_put(prompt, row)
         cache = model.__dict__.setdefault("_generate_fns", {})
