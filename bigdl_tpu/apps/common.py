@@ -39,6 +39,13 @@ def train_parser(prog: str, default_batch: int = 128,
     p.add_argument("--appName", default=prog)
     p.add_argument("--synthetic-size", type=int, default=2048,
                    help="records of synthetic data when no -f")
+    p.add_argument("--gradientClipL2Norm", type=float, default=0.0,
+                   help="clip gradients to this global L2 norm (0 = off; "
+                   "reference setGradientClippingByl2Norm)")
+    p.add_argument("--gradientClipConstant", type=float, nargs=2,
+                   default=None, metavar=("MIN", "MAX"),
+                   help="clamp every gradient element into [MIN, MAX] "
+                   "(reference setConstantGradientClipping)")
     return p
 
 
@@ -70,6 +77,10 @@ def build_optimizer(model, train_set, criterion, args,
         momentum=args.momentum,
         weightdecay=args.weightDecay))
     opt.set_end_when(Trigger.max_epoch(args.maxEpoch))
+    if getattr(args, "gradientClipL2Norm", 0.0):
+        opt.set_gradient_clipping_by_l2_norm(args.gradientClipL2Norm)
+    if getattr(args, "gradientClipConstant", None):
+        opt.set_constant_gradient_clipping(*args.gradientClipConstant)
     if args.model and args.state:
         opt.resume(args.model, args.state)
     if args.checkpoint:

@@ -65,6 +65,39 @@ def _reg_loss(params, reg_pairs):
     return total
 
 
+def make_grad_clipper(clip):
+    """Gradient-clip transform from an Optimizer's ``_grad_clip`` setting —
+    a dict with optional ``"constant": (lo, hi)`` (elementwise clamp) and
+    ``"l2": max_norm`` (global-L2 rescale) entries. Both may be active at
+    once (reference: independent parameter processors); the clamp applies
+    FIRST, then the norm bound, so the L2 guarantee always holds on the
+    final gradient. ``None``/empty: identity. For the ZeRO-1 sharded
+    plane, pass ``axis_name`` so the squared norm reduces across the
+    slice shards (each device holds 1/P of the flat gradient)."""
+    if not clip:
+        return lambda g, axis_name=None: g
+    const = clip.get("constant")
+    max_norm = clip.get("l2")
+
+    def apply(g, axis_name=None):
+        if const is not None:
+            lo, hi = const
+            g = jax.tree_util.tree_map(lambda x: jnp.clip(x, lo, hi), g)
+        if max_norm is not None:
+            leaves = jax.tree_util.tree_leaves(g)
+            gn_sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in leaves)
+            if axis_name is not None:
+                gn_sq = jax.lax.psum(gn_sq, axis_name)
+            scale = jnp.minimum(1.0, max_norm
+                                * jax.lax.rsqrt(jnp.maximum(gn_sq, 1e-24)))
+            g = jax.tree_util.tree_map(
+                lambda x: (x * scale).astype(x.dtype), g)
+        return g
+
+    return apply
+
+
 def make_training_loss_fn(model, criterion, policy, reg_pairs, remat,
                           buffers, rng, data, labels):
     """The ONE training loss closure shared by every step builder (local,
@@ -151,6 +184,7 @@ class Optimizer:
         self._resume_from: Optional[Tuple[str, str]] = None
         self._profile: Optional[Tuple[str, int, int]] = None
         self._remat = False
+        self._grad_clip = {}
         self._steps_per_dispatch = 1
         self._eval_cache = {}  # validation scorer jit, traced once
         from bigdl_tpu.ops.precision import DtypePolicy
@@ -224,6 +258,32 @@ class Optimizer:
                                  "expected True/False, 'full' or 'conv'")
         else:
             self._remat = bool(enabled)
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float) -> "Optimizer":
+        """Scale gradients so their GLOBAL L2 norm (over the whole parameter
+        tree, and across data shards under DistriOptimizer) never exceeds
+        ``clip_norm`` (reference ``Optimizer.setGradientClippingByl2Norm``).
+        Applied inside the jitted step, between autodiff and the update."""
+        if clip_norm <= 0:
+            raise ValueError(f"clip_norm must be > 0, got {clip_norm}")
+        self._grad_clip = {**self._grad_clip, "l2": float(clip_norm)}
+        return self
+
+    def set_constant_gradient_clipping(self, min_value: float,
+                                       max_value: float) -> "Optimizer":
+        """Clamp every gradient element into [min_value, max_value]
+        (reference ``Optimizer.setConstantGradientClipping``)."""
+        if not min_value < max_value:
+            raise ValueError(f"need min_value < max_value, got "
+                             f"[{min_value}, {max_value}]")
+        self._grad_clip = {**self._grad_clip,
+                           "constant": (float(min_value), float(max_value))}
+        return self
+
+    def disable_gradient_clipping(self) -> "Optimizer":
+        """reference ``Optimizer.disableGradientClipping`` (clears both)."""
+        self._grad_clip = {}
         return self
 
     def set_steps_per_dispatch(self, k: int) -> "Optimizer":
@@ -319,13 +379,15 @@ class LocalOptimizer(Optimizer):
         reg_pairs = _regularizer_pairs(model)
         policy = self.precision
         remat = self._remat
+        clip = make_grad_clipper(self._grad_clip)
 
         def step(params, buffers, opt_state, rng, data, labels):
             loss_fn = make_training_loss_fn(
                 model, criterion, policy, reg_pairs, remat,
                 buffers, rng, data, labels)
             grads, (new_buf, loss) = jax.grad(loss_fn, has_aux=True)(params)
-            new_params, new_opt_state = optim.update(grads, opt_state, params)
+            new_params, new_opt_state = optim.update(clip(grads), opt_state,
+                                                     params)
             return new_params, new_buf, new_opt_state, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -339,6 +401,8 @@ class LocalOptimizer(Optimizer):
         policy = self.precision
         remat = self._remat
 
+        clip = make_grad_clipper(self._grad_clip)
+
         def multi(params, buffers, opt_state, keys, datas, labels):
             def body(carry, inp):
                 p, b, o = carry
@@ -346,7 +410,7 @@ class LocalOptimizer(Optimizer):
                 loss_fn = make_training_loss_fn(
                     model, criterion, policy, reg_pairs, remat, b, key, x, y)
                 grads, (nb, loss) = jax.grad(loss_fn, has_aux=True)(p)
-                np_, no = optim.update(grads, o, p)
+                np_, no = optim.update(clip(grads), o, p)
                 return (np_, nb, no), loss
 
             (p, b, o), losses = jax.lax.scan(
@@ -365,6 +429,7 @@ class LocalOptimizer(Optimizer):
         reg_pairs = _regularizer_pairs(model)
         policy = self.precision
         remat = self._remat
+        clip = make_grad_clipper(self._grad_clip)
 
         def multi(params, buffers, opt_state, keys, x_cache, y_cache, idx):
             def body(carry, inp):
@@ -374,7 +439,7 @@ class LocalOptimizer(Optimizer):
                     model, criterion, policy, reg_pairs, remat, b, key,
                     x_cache[ix], y_cache[ix])
                 grads, (nb, loss) = jax.grad(loss_fn, has_aux=True)(p)
-                np_, no = optim.update(grads, o, p)
+                np_, no = optim.update(clip(grads), o, p)
                 return (np_, nb, no), loss
 
             (p, b, o), losses = jax.lax.scan(

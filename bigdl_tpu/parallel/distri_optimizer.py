@@ -53,6 +53,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bigdl_tpu.nn.module import functional_apply
 from bigdl_tpu.optim.optimizer import (LocalOptimizer, Optimizer,
                                        _regularizer_pairs, _reg_loss,
+                                       make_grad_clipper,
                                        make_training_loss_fn)
 from bigdl_tpu.parallel.mesh import DATA_AXIS, TENSOR_AXIS, MeshTopology
 
@@ -240,6 +241,8 @@ class DistriOptimizer(LocalOptimizer):
         policy = self.precision
         remat = self._remat
 
+        clip = make_grad_clipper(self._grad_clip)
+
         def step(params, buffers, opt_state, rng, data, labels):
             loss_fn = make_training_loss_fn(
                 model, criterion, policy, reg_pairs, remat,
@@ -250,7 +253,10 @@ class DistriOptimizer(LocalOptimizer):
                 # bf16 payload ≙ reference FP16CompressedTensor (truncated fp32)
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(jnp.bfloat16).astype(g.dtype), grads)
-            new_params, new_opt_state = optim.update(grads, opt_state, params)
+            # clip the GLOBAL (GSPMD-allreduced) gradient, post-compression,
+            # so the update sees the same clipped grad on every device
+            new_params, new_opt_state = optim.update(clip(grads), opt_state,
+                                                     params)
             return new_params, new_buf, new_opt_state, loss
 
         rep, bat = self._replicated, self._batch_sharding
@@ -288,6 +294,7 @@ class DistriOptimizer(LocalOptimizer):
         model, criterion, optim = self.model, self.criterion, self.optim_method
         reg_pairs = _regularizer_pairs(model)
         compress = self.compress_gradients
+        clip = make_grad_clipper(self._grad_clip)
         mesh, n_dev = self.mesh, self._n_data
 
         # Flat-parameter geometry (reference AllReduceParameter slice layout).
@@ -328,6 +335,9 @@ class DistriOptimizer(LocalOptimizer):
             grad_slice = jax.lax.psum_scatter(
                 flat_grads, DATA_AXIS, scatter_dimension=0, tiled=True) / n_dev
             grad_slice = grad_slice.astype(jnp.float32)
+            # clip on the slice: the global L2 norm psums the per-slice
+            # squared norms (each device owns 1/P of the flat gradient)
+            grad_slice = clip(grad_slice, axis_name=DATA_AXIS)
             rank = jax.lax.axis_index(DATA_AXIS)
             param_slice = jax.lax.dynamic_slice(flat_params, (rank * chunk,), (chunk,))
             new_slice, new_opt_state = optim.update(grad_slice, opt_state, param_slice)
