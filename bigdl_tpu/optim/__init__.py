@@ -1,7 +1,7 @@
 """bigdl_tpu.optim — optimization layer (reference ``$B/optim/``)."""
 
 from bigdl_tpu.optim.methods import (
-    OptimMethod, SGD, Adagrad, Adam, Adamax, Adadelta, RMSprop, LBFGS,
+    OptimMethod, SGD, Adagrad, Adam, AdamW, Adamax, Adadelta, RMSprop, LBFGS,
     LearningRateSchedule, Default, Poly, Step, MultiStep, EpochStep,
     EpochDecay, Regime, EpochSchedule, Warmup,
 )

@@ -295,10 +295,14 @@ class Adam(OptimMethod):
         s["v"] = _tree_zeros(params)
         return s
 
+    def _scheduled_lr(self, state):
+        return self.learningrate / (1.0 + state["evalCounter"]
+                                    * self.learningrate_decay)
+
     def update(self, grads, state, params):
         grads = self._decayed(grads, params)
         t = state["evalCounter"] + 1
-        lr = self.learningrate / (1.0 + state["evalCounter"] * self.learningrate_decay)
+        lr = self._scheduled_lr(state)
         b1, b2 = self.beta1, self.beta2
         m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
         v = jax.tree_util.tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], grads)
@@ -308,6 +312,29 @@ class Adam(OptimMethod):
             lambda p, m_, v_: p - lr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.epsilon),
             params, m, v)
         return new_params, {**state, "m": m, "v": v, "evalCounter": t}
+
+
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (Loshchilov & Hutter) — the
+    standard transformer-LM optimizer, added beyond the reference (whose
+    ``weightDecay`` is L2-coupled: it enters the gradient and hence the
+    adaptive moments). Here decay multiplies the parameter directly by
+    ``(1 - lr*decay)`` at the update, outside the moment estimates."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, weightdecay: float = 0.01):
+        super().__init__(learningrate, learningrate_decay, beta1, beta2,
+                         epsilon, weightdecay=0.0)
+        self.decoupled_decay = weightdecay
+
+    def update(self, grads, state, params):
+        lr = self._scheduled_lr(state)
+        if self.decoupled_decay:
+            params = jax.tree_util.tree_map(
+                lambda p: p * (1.0 - lr * self.decoupled_decay), params)
+        return super().update(grads, state, params)
 
 
 class Adamax(OptimMethod):

@@ -75,14 +75,18 @@ def make_grad_clipper(clip):
     plane, pass ``axis_name`` so the squared norm reduces across the
     slice shards (each device holds 1/P of the flat gradient)."""
     if not clip:
-        return lambda g, axis_name=None: g
+        return lambda g, axis_name=None, valid_mask=None: g
     const = clip.get("constant")
     max_norm = clip.get("l2")
 
-    def apply(g, axis_name=None):
+    def apply(g, axis_name=None, valid_mask=None):
         if const is not None:
             lo, hi = const
             g = jax.tree_util.tree_map(lambda x: jnp.clip(x, lo, hi), g)
+        if valid_mask is not None:
+            # flat-vector padding lanes (ZeRO-1): a clamp range excluding 0
+            # would lift the pad zeros and pollute the global norm below
+            g = jax.tree_util.tree_map(lambda x: x * valid_mask, g)
         if max_norm is not None:
             leaves = jax.tree_util.tree_leaves(g)
             gn_sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32)))

@@ -335,10 +335,14 @@ class DistriOptimizer(LocalOptimizer):
             grad_slice = jax.lax.psum_scatter(
                 flat_grads, DATA_AXIS, scatter_dimension=0, tiled=True) / n_dev
             grad_slice = grad_slice.astype(jnp.float32)
-            # clip on the slice: the global L2 norm psums the per-slice
-            # squared norms (each device owns 1/P of the flat gradient)
-            grad_slice = clip(grad_slice, axis_name=DATA_AXIS)
             rank = jax.lax.axis_index(DATA_AXIS)
+            # clip on the slice: the global L2 norm psums the per-slice
+            # squared norms (each device owns 1/P of the flat gradient);
+            # the mask keeps PAD lanes at zero through the clamp so the
+            # norm matches the allreduce path exactly
+            lane = rank * chunk + jnp.arange(chunk)
+            grad_slice = clip(grad_slice, axis_name=DATA_AXIS,
+                              valid_mask=(lane < n).astype(jnp.float32))
             param_slice = jax.lax.dynamic_slice(flat_params, (rank * chunk,), (chunk,))
             new_slice, new_opt_state = optim.update(grad_slice, opt_state, param_slice)
             # republish slices (≙ sendWeightPartition + getWeights)
