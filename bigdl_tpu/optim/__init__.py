@@ -3,7 +3,7 @@
 from bigdl_tpu.optim.methods import (
     OptimMethod, SGD, Adagrad, Adam, AdamW, Adamax, Adadelta, RMSprop, LBFGS,
     LearningRateSchedule, Default, Poly, Step, MultiStep, EpochStep,
-    EpochDecay, Regime, EpochSchedule, Warmup,
+    EpochDecay, Regime, EpochSchedule, Warmup, CosineDecay,
 )
 from bigdl_tpu.optim.triggers import Trigger
 from bigdl_tpu.optim.validation import (

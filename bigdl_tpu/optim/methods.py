@@ -125,6 +125,25 @@ class EpochSchedule(LearningRateSchedule):
         return wd
 
 
+class CosineDecay(LearningRateSchedule):
+    """Cosine annealing from base_lr to ``min_lr`` over ``decay_iterations``
+    (the standard transformer-LM schedule; compose with ``Warmup`` for the
+    canonical warmup+cosine recipe — no reference equivalent, the
+    reference predates it)."""
+
+    def __init__(self, decay_iterations: int, min_lr: float = 0.0):
+        if decay_iterations <= 0:
+            raise ValueError("decay_iterations must be > 0")
+        self.decay_iterations = decay_iterations
+        self.min_lr = min_lr
+
+    def rate(self, base_lr, state):
+        it = jnp.minimum(state["evalCounter"], self.decay_iterations)
+        frac = it.astype(jnp.float32) / self.decay_iterations
+        return self.min_lr + 0.5 * (base_lr - self.min_lr) * (
+            1.0 + jnp.cos(jnp.pi * frac))
+
+
 class Warmup(LearningRateSchedule):
     """Linear warmup then delegate (common TPU-scale recipe; no reference
     equivalent — large-batch training needs it)."""
@@ -136,8 +155,12 @@ class Warmup(LearningRateSchedule):
     def rate(self, base_lr, state):
         it = state["evalCounter"]
         warm = base_lr * (it + 1) / self.warmup_iterations
+        # the inner schedule starts at 0 AFTER warmup (standard composed
+        # semantics: Warmup(N, CosineDecay(T)) anneals over [N, N+T])
+        after_state = {**state,
+                       "evalCounter": it - self.warmup_iterations}
         return jnp.where(it < self.warmup_iterations, warm,
-                         self.after.rate(base_lr, state))
+                         self.after.rate(base_lr, after_state))
 
 
 # --------------------------------------------------------------------------
@@ -328,6 +351,11 @@ class AdamW(Adam):
         super().__init__(learningrate, learningrate_decay, beta1, beta2,
                          epsilon, weightdecay=0.0)
         self.decoupled_decay = weightdecay
+
+    def get_hyper_parameter(self):
+        from bigdl_tpu.utils.table import T
+        return T(learningRate=self.learningrate,
+                 weightDecay=self.decoupled_decay)
 
     def update(self, grads, state, params):
         lr = self._scheduled_lr(state)

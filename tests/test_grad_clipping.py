@@ -180,14 +180,35 @@ class TestAdamW:
 
     def test_decay_actually_decoupled(self):
         from bigdl_tpu.optim import Adam, AdamW
-        # with zero gradient, AdamW still shrinks weights; coupled-L2 Adam
-        # with weightdecay feeds decay through the moments instead
         w = {"w": jnp.full((3,), 10.0)}
+        # zero gradient: AdamW shrinks weights by exactly (1 - lr*decay)
         aw = AdamW(learningrate=0.1, weightdecay=0.5)
-        st = aw.init_state(w)
-        out, _ = aw.update({"w": jnp.zeros(3)}, st, w)
+        out, _ = aw.update({"w": jnp.zeros(3)}, aw.init_state(w), w)
         np.testing.assert_allclose(np.asarray(out["w"]), 10.0 * (1 - 0.05),
                                    rtol=1e-6)
+        # coupled-L2 Adam instead routes decay through the moments: the
+        # first zero-grad step moves by ~lr/(1+eps'), NOT by lr*decay*w
+        ad = Adam(learningrate=0.1, weightdecay=0.5)
+        out2, _ = ad.update({"w": jnp.zeros(3)}, ad.init_state(w), w)
+        assert not np.allclose(np.asarray(out2["w"]), 10.0 * (1 - 0.05),
+                               rtol=1e-3)
+
+    def test_adamw_reports_decay(self):
+        from bigdl_tpu.optim import AdamW
+        hp = AdamW(weightdecay=0.1).get_hyper_parameter()
+        assert float(hp["weightDecay"]) == 0.1
+
+    def test_warmup_cosine_continuous(self):
+        from bigdl_tpu.optim import CosineDecay, Warmup
+        sched = Warmup(10, CosineDecay(100))
+        # last warmup step reaches base_lr; first post-warmup step is the
+        # cosine's START (no discontinuous drop)
+        r_last = float(sched.rate(1.0, {"evalCounter": jnp.asarray(9)}))
+        r_next = float(sched.rate(1.0, {"evalCounter": jnp.asarray(10)}))
+        np.testing.assert_allclose(r_last, 1.0, rtol=1e-6)
+        np.testing.assert_allclose(r_next, 1.0, rtol=1e-6)
+        r_end = float(sched.rate(1.0, {"evalCounter": jnp.asarray(110)}))
+        np.testing.assert_allclose(r_end, 0.0, atol=1e-7)
 
 
 class TestShardedPadLanes:
@@ -217,3 +238,30 @@ class TestShardedPadLanes:
 
         np.testing.assert_allclose(run("sharded"), run("allreduce"),
                                    atol=2e-6)
+
+
+class TestCosineDecay:
+    def test_endpoints_and_midpoint(self):
+        from bigdl_tpu.optim import CosineDecay
+        sched = CosineDecay(100, min_lr=0.1)
+        r0 = float(sched.rate(1.0, {"evalCounter": jnp.asarray(0)}))
+        rm = float(sched.rate(1.0, {"evalCounter": jnp.asarray(50)}))
+        re_ = float(sched.rate(1.0, {"evalCounter": jnp.asarray(100)}))
+        rpast = float(sched.rate(1.0, {"evalCounter": jnp.asarray(500)}))
+        np.testing.assert_allclose(r0, 1.0, rtol=1e-6)
+        np.testing.assert_allclose(rm, 0.55, rtol=1e-6)  # (1+0.1)/2
+        np.testing.assert_allclose(re_, 0.1, rtol=1e-6)
+        np.testing.assert_allclose(rpast, 0.1, rtol=1e-6)  # clamps
+
+    def test_warmup_cosine_composition_trains(self):
+        from bigdl_tpu.optim import CosineDecay, Warmup
+        sched = Warmup(2, CosineDecay(10))
+        from bigdl_tpu.utils.rng import manual_seed
+        manual_seed(3)
+        model = build_model()
+        ds = DataSet.array(make_data()).transform(SampleToBatch(batch_size=8))
+        opt = Optimizer(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1,
+                                 learningrate_schedule=sched))
+        opt.set_end_when(Trigger.max_iteration(4))
+        opt.optimize()
