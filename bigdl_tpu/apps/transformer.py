@@ -243,6 +243,8 @@ def generate_cmd(argv) -> None:
     ap.add_argument("--numBeams", type=int, default=0)
     ap.add_argument("--lengthPenalty", type=float, default=1.0)
     ap.add_argument("--eosId", type=int, default=None)
+    ap.add_argument("--repetitionPenalty", type=float, default=1.0)
+    ap.add_argument("--minNewTokens", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--int8", action="store_true",
                     help="decode with the int8 weight-only quantized twin")
@@ -267,6 +269,8 @@ def generate_cmd(argv) -> None:
                    top_p=args.topP, greedy=args.greedy,
                    num_beams=args.numBeams,
                    length_penalty=args.lengthPenalty, eos_id=args.eosId,
+                   repetition_penalty=args.repetitionPenalty,
+                   min_new_tokens=args.minNewTokens,
                    key=jax.random.PRNGKey(args.seed))
     ids = np.asarray(out[0]).astype(int).tolist()  # one host transfer
     n0 = prompt.shape[1]

@@ -87,37 +87,62 @@ def _decode_modules(model: Module):
 
 def _build_decode_fn(model: Module, max_new_tokens: int, temperature: float,
                      top_k: int, top_p: float, greedy: bool,
-                     eos_id: Optional[int], pad_id: int):
+                     eos_id: Optional[int], pad_id: int,
+                     repetition_penalty: float = 1.0,
+                     min_new_tokens: int = 0):
     """Pure (params, buffers, prompt, key) -> (B, S0+max_new) id matrix."""
+    rep = float(repetition_penalty)
 
-    def sample(logp, key):
+    def sample(logp, key, seen, t):
+        if rep != 1.0:
+            # CTRL-style: log-probs are negative, so multiplying a seen
+            # token's log-prob by the penalty (> 1) pushes it down
+            logp = jnp.where(seen, logp * rep, logp)
+        if eos_id is not None and min_new_tokens > 0:
+            # t = index of the token being generated (0-based)
+            logp = jnp.where((t < min_new_tokens)
+                             & (jnp.arange(logp.shape[-1])[None, :]
+                                == eos_id - 1), -jnp.inf, logp)
         return sample_token(logp, key, temperature=temperature, top_k=top_k,
                             top_p=top_p, greedy=greedy)
 
     def run(params, buffers, prompt, key):
         out, bufs = functional_apply(model, params, buffers, prompt,
                                      training=False)
+        v = out.shape[-1]
+        if rep != 1.0:
+            seen = jnp.zeros((prompt.shape[0], v), bool)
+            idx0 = jnp.clip(prompt.astype(jnp.int32) - 1, 0, v - 1)
+            seen = seen.at[jnp.arange(prompt.shape[0])[:, None],
+                           idx0].set(True)
+        else:
+            seen = jnp.zeros((prompt.shape[0], 1), bool)  # unused
         key, sub = jax.random.split(key)
-        tok = sample(out[:, -1].astype(jnp.float32), sub)
+        tok = sample(out[:, -1].astype(jnp.float32), sub, seen, 0)
+        if rep != 1.0:
+            seen = seen.at[jnp.arange(tok.shape[0]), tok - 1].set(True)
         if eos_id is None:
             done = jnp.zeros(tok.shape, bool)
         else:
             done = tok == eos_id
 
-        def body(carry, _):
-            bufs, tok, key, done = carry
+        def body(carry, t):
+            bufs, tok, key, done, seen = carry
             step_in = tok[:, None].astype(prompt.dtype)
             out, bufs = functional_apply(model, params, bufs, step_in,
                                          training=False)
             key, sub = jax.random.split(key)
-            nxt = sample(out[:, -1].astype(jnp.float32), sub)
+            nxt = sample(out[:, -1].astype(jnp.float32), sub, seen, t)
             nxt = jnp.where(done, jnp.int32(pad_id), nxt)
+            if rep != 1.0:
+                seen = seen.at[jnp.arange(nxt.shape[0]), nxt - 1].set(True)
             if eos_id is not None:
                 done = done | (nxt == eos_id)
-            return (bufs, nxt, key, done), nxt
+            return (bufs, nxt, key, done, seen), nxt
 
-        (_, _, _, _), rest = jax.lax.scan(
-            body, (bufs, tok, key, done), None, length=max_new_tokens - 1)
+        (_, _, _, _, _), rest = jax.lax.scan(
+            body, (bufs, tok, key, done, seen),
+            jnp.arange(1, max_new_tokens))
         toks = jnp.concatenate([tok[:, None], rest.T], axis=1)
         return jnp.concatenate([prompt, toks.astype(prompt.dtype)], axis=1)
 
@@ -228,6 +253,7 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
              temperature: float = 1.0, top_k: int = 0, top_p: float = 0.0,
              greedy: bool = False, eos_id: Optional[int] = None,
              pad_id: Optional[int] = None,
+             repetition_penalty: float = 1.0, min_new_tokens: int = 0,
              num_beams: int = 0, length_penalty: float = 1.0,
              mesh=None, data_axis: str = "data",
              tensor_axis: Optional[str] = None,
@@ -260,6 +286,11 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
     if num_beams > 1 and (top_k or top_p):
         raise ValueError("beam search is deterministic; top_k/top_p do not "
                          "compose with num_beams")
+    if num_beams > 1 and (repetition_penalty != 1.0 or min_new_tokens):
+        raise ValueError("repetition_penalty/min_new_tokens apply to the "
+                         "sampling path, not beam search")
+    if repetition_penalty <= 0:
+        raise ValueError("repetition_penalty must be > 0")
     if num_beams == 1:
         greedy = True  # width-1 beam search IS greedy decoding
     prompt = jnp.asarray(prompt)
@@ -339,6 +370,7 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
         # mesh-agnostic, and jax.jit already specialises per input sharding
         sig = (b, s0, max_new_tokens, float(temperature), int(top_k),
                float(top_p), bool(greedy), eos_id, pad_id,
+               float(repetition_penalty), int(min_new_tokens),
                int(num_beams), float(length_penalty))
         fn = cache.get(sig)
         if fn is None:
@@ -346,8 +378,11 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
                 fn = _build_beam_fn(model, max_new_tokens, num_beams,
                                     length_penalty, eos_id, pad_id)
             else:
-                fn = _build_decode_fn(model, max_new_tokens, temperature,
-                                      top_k, top_p, greedy, eos_id, pad_id)
+                fn = _build_decode_fn(
+                    model, max_new_tokens, temperature, top_k, top_p,
+                    greedy, eos_id, pad_id,
+                    repetition_penalty=repetition_penalty,
+                    min_new_tokens=min_new_tokens)
             cache[sig] = fn
         if num_beams > 1:
             out = fn(params, buffers, prompt)

@@ -353,7 +353,6 @@ class AdamW(Adam):
         self.decoupled_decay = weightdecay
 
     def get_hyper_parameter(self):
-        from bigdl_tpu.utils.table import T
         return T(learningRate=self.learningrate,
                  weightDecay=self.decoupled_decay)
 

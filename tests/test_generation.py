@@ -406,3 +406,36 @@ class TestTensorParallelDecode:
 
         txt = jax.jit(fwd).lower(params, buffers, x).compile().as_text()
         assert "all-reduce" in txt
+
+
+class TestSamplingKnobs:
+    def test_repetition_penalty_reduces_repeats(self):
+        model = tiny_lm()
+        p = jnp.ones((1, 2))
+        plain = np.asarray(generate(model, p, 24, greedy=True))[0, 2:]
+        pen = np.asarray(generate(model, p, 24, greedy=True,
+                                  repetition_penalty=1.8))[0, 2:]
+
+        def repeats(seq):
+            _, counts = np.unique(seq, return_counts=True)
+            return int((counts - 1).sum())
+
+        # untrained greedy LMs loop hard; the penalty must cut repeats
+        assert repeats(pen) < repeats(plain)
+
+    def test_min_new_tokens_suppresses_eos(self):
+        model = tiny_lm()
+        p = jnp.ones((1, 2))
+        probe = generate(model, p, 8, greedy=True)
+        eos = int(np.asarray(probe)[0, 2])  # greedy would emit this first
+        out = np.asarray(generate(model, p, 8, greedy=True, eos_id=eos,
+                                  min_new_tokens=4))[0, 2:]
+        assert (out[:4] != eos).all()
+
+    def test_knobs_rejected_with_beams(self):
+        model = tiny_lm()
+        with pytest.raises(ValueError, match="sampling path"):
+            generate(model, jnp.ones((1, 2)), 3, num_beams=2,
+                     repetition_penalty=1.5)
+        with pytest.raises(ValueError, match="repetition_penalty"):
+            generate(model, jnp.ones((1, 2)), 3, repetition_penalty=0.0)
