@@ -10,5 +10,8 @@ format directly and ``caffe`` walks the protobuf wire format by hand
 
 from bigdl_tpu.interop.torch_file import load_torch, save_torch
 from bigdl_tpu.interop.caffe import CaffeLoader, load_caffe
+from bigdl_tpu.interop.state_dict import (export_lm_state_dict,
+                                          import_lm_state_dict)
 
-__all__ = ["load_torch", "save_torch", "CaffeLoader", "load_caffe"]
+__all__ = ["load_torch", "save_torch", "CaffeLoader", "load_caffe",
+    "export_lm_state_dict", "import_lm_state_dict"]

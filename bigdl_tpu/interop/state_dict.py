@@ -1,0 +1,147 @@
+"""Torch-convention state_dict interop for the causal LM.
+
+The reference-era interop (``torch_file.py`` .t7, ``caffe.py``) predates
+transformers; a migrating LM user's checkpoint today is a torch
+``state_dict``. This module maps ``models.transformer.build_lm`` models to
+the standard torch naming so weights move in either direction:
+
+    embedding.weight                                 LookupTable (V, E)
+    encoder.layers.{i}.self_attn.in_proj_weight      (3E, E)  q;k;v stacked
+    encoder.layers.{i}.self_attn.in_proj_bias        (3E,)
+    encoder.layers.{i}.self_attn.out_proj.weight     (E, E)
+    encoder.layers.{i}.self_attn.out_proj.bias       (E,)
+    encoder.layers.{i}.linear1.{weight,bias}         FFN up
+    encoder.layers.{i}.linear2.{weight,bias}         FFN down
+    encoder.layers.{i}.norm1.{weight,bias}
+    encoder.layers.{i}.norm2.{weight,bias}
+    encoder.norm.{weight,bias}                       final pre-norm LN
+    lm_head.{weight,bias}                            (V, E) vocab projection
+
+Layouts already match torch's (``nn.MultiheadAttention`` in_proj stacking,
+``Linear`` (out, in)) — the module zoo keeps torch conventions precisely so
+oracle tests and weight interchange line up — so this is a NAME mapping with
+shape checks, no transposes. Token ids stay 1-based on our side; the
+embedding TABLE is identical (id k reads row k-1, as torch's id k-1 does).
+
+Both LM tails (``TimeDistributed(Linear)+LogSoftMax`` and the fused
+``LMHead``) serialise to the same ``lm_head.*`` keys, so checkpoints
+interchange between them through this module.
+
+Activation note: the FFN gelu is the TANH-APPROXIMATE form (jax.nn.gelu
+default, = torch ``F.gelu(approximate="tanh")`` / HF "gelu_new"); a torch
+module built with the exact-erf ``"gelu"`` string differs at ~1e-2.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from bigdl_tpu.nn.attention import (LayerNorm, MultiHeadAttention,
+                                    TransformerEncoder)
+from bigdl_tpu.nn.linear import LMHead, Linear, LookupTable
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.recurrent import TimeDistributed
+
+
+def _lm_parts(model: Module):
+    """(embedding, encoder, head Linear-like) of a build_lm-shaped model."""
+    lookups = [m for m in model.modules() if isinstance(m, LookupTable)]
+    encoders = [m for m in model.modules()
+                if isinstance(m, TransformerEncoder)]
+    heads = [m for m in model.modules() if isinstance(m, LMHead)]
+    if not heads:
+        heads = [td.inner for td in model.modules()
+                 if isinstance(td, TimeDistributed)
+                 and isinstance(getattr(td, "inner", None), Linear)]
+    if not (len(lookups) == 1 and len(encoders) == 1 and len(heads) == 1):
+        raise ValueError(
+            "expected a build_lm-shaped model (one LookupTable, one "
+            f"TransformerEncoder, one LM head); found {len(lookups)}/"
+            f"{len(encoders)}/{len(heads)}")
+    return lookups[0], encoders[0], heads[0]
+
+
+def _named_params(model: Module) -> List[Tuple[str, Module, str]]:
+    """[(torch_name, module, param_name)] in deterministic order."""
+    emb, enc, head = _lm_parts(model)
+    out: List[Tuple[str, Module, str]] = [
+        ("embedding.weight", emb, "weight")]
+    for i in range(enc.num_layers):
+        layer = enc._modules[f"layer{i}"]
+        if getattr(layer, "moe_experts", 0):
+            raise ValueError("MoE layers have no torch-convention mapping")
+        p = f"encoder.layers.{i}"
+        attn: MultiHeadAttention = layer.self_attn
+        out.append((f"{p}.self_attn.in_proj_weight", attn, "in_proj_weight"))
+        if attn.with_bias:
+            out.append((f"{p}.self_attn.in_proj_bias", attn, "in_proj_bias"))
+        out.append((f"{p}.self_attn.out_proj.weight", attn,
+                    "out_proj_weight"))
+        if attn.with_bias:
+            out.append((f"{p}.self_attn.out_proj.bias", attn,
+                        "out_proj_bias"))
+        for lin_name in ("linear1", "linear2"):
+            lin = layer._modules[lin_name]
+            out.append((f"{p}.{lin_name}.weight", lin, "weight"))
+            if lin.with_bias:
+                out.append((f"{p}.{lin_name}.bias", lin, "bias"))
+        for norm_name in ("norm1", "norm2"):
+            ln: LayerNorm = layer._modules[norm_name]
+            out.append((f"{p}.{norm_name}.weight", ln, "weight"))
+            out.append((f"{p}.{norm_name}.bias", ln, "bias"))
+    if enc.final_norm is not None:
+        out.append(("encoder.norm.weight", enc.final_norm, "weight"))
+        out.append(("encoder.norm.bias", enc.final_norm, "bias"))
+    out.append(("lm_head.weight", head, "weight"))
+    if head.with_bias:
+        out.append(("lm_head.bias", head, "bias"))
+    return out
+
+
+def export_lm_state_dict(model: Module) -> Dict[str, np.ndarray]:
+    """Torch-convention ``{name: fp32 numpy array}`` of a build_lm model."""
+    return {name: np.asarray(mod._parameters[pname], np.float32)
+            for name, mod, pname in _named_params(model)}
+
+
+def import_lm_state_dict(model: Module, state_dict: Dict[str, Any],
+                         strict: bool = True) -> Module:
+    """Load torch-convention weights into a build_lm model IN PLACE.
+
+    Accepts numpy arrays, jax arrays, or anything ``np.asarray`` handles
+    (torch tensors: pass ``t.detach().numpy()`` — torch is not imported
+    here). ``strict=True`` (torch semantics) rejects both missing and
+    unexpected keys; ``strict=False`` loads the intersection — e.g. a
+    GPT-style checkpoint with tied embeddings that omits ``lm_head.weight``
+    loads everything else and keeps the model's current head. All shapes
+    are validated BEFORE any assignment, so a rejected state_dict never
+    leaves the model half-loaded.
+    """
+    import jax.numpy as jnp
+    entries = _named_params(model)
+    if strict:
+        missing = [n for n, _, _ in entries if n not in state_dict]
+        if missing:
+            raise KeyError(f"state_dict is missing {missing[:4]}"
+                           f"{'...' if len(missing) > 4 else ''} "
+                           "(strict=False to load the intersection)")
+        known = {n for n, _, _ in entries}
+        extra = sorted(set(state_dict) - known)
+        if extra:
+            raise KeyError(f"unexpected keys {extra[:4]}"
+                           f"{'...' if len(extra) > 4 else ''} "
+                           "(strict=False to ignore)")
+    staged = []
+    for name, mod, pname in entries:
+        if name not in state_dict:
+            continue  # strict=False: keep the model's current value
+        val = np.asarray(state_dict[name], np.float32)
+        want = tuple(np.shape(mod._parameters[pname]))
+        if tuple(val.shape) != want:
+            raise ValueError(f"{name}: shape {val.shape} != expected {want}")
+        staged.append((mod, pname, val))
+    for mod, pname, val in staged:
+        mod._parameters[pname] = jnp.asarray(val)
+    return model

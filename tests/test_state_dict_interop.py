@@ -1,0 +1,123 @@
+"""Torch-convention state_dict interop for the causal LM."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.interop import export_lm_state_dict, import_lm_state_dict
+from bigdl_tpu.models import transformer
+
+E, H, F, V = 16, 4, 32, 23
+
+
+def lm(**kw):
+    return transformer.build_lm(V, E, H, ffn_dim=F, num_layers=2,
+                                max_len=32, **kw)
+
+
+class TestRoundTrip:
+    def test_export_names(self):
+        sd = export_lm_state_dict(lm())
+        assert "embedding.weight" in sd
+        assert "encoder.layers.0.self_attn.in_proj_weight" in sd
+        assert sd["encoder.layers.1.linear2.weight"].shape == (E, F)
+        assert "encoder.norm.weight" in sd
+        assert sd["lm_head.weight"].shape == (V, E)
+
+    def test_roundtrip_identical_outputs(self):
+        src, dst = lm(), lm()
+        x = jnp.asarray([[3.0, 7.0, 1.0, 9.0]])
+        assert not np.allclose(np.asarray(src.predict(x)),
+                               np.asarray(dst.predict(x)))
+        import_lm_state_dict(dst, export_lm_state_dict(src))
+        np.testing.assert_allclose(np.asarray(dst.predict(x)),
+                                   np.asarray(src.predict(x)), atol=1e-6)
+
+    def test_fused_and_unfused_tails_interchange(self):
+        """The fused LMHead tail and TimeDistributed(Linear) tail share the
+        lm_head.* keys, so checkpoints cross-load."""
+        src = lm(fused_head=True)
+        dst = lm(fused_head=False)
+        import_lm_state_dict(dst, export_lm_state_dict(src))
+        x = jnp.asarray([[5.0, 2.0, 8.0]])
+        np.testing.assert_allclose(
+            np.asarray(dst.predict(x)),
+            np.asarray(src.evaluate_mode().predict(x)), atol=1e-6)
+
+    def test_missing_and_extra_keys(self):
+        sd = export_lm_state_dict(lm())
+        sd.pop("lm_head.weight")
+        with pytest.raises(KeyError, match="missing"):
+            import_lm_state_dict(lm(), sd)
+        sd2 = export_lm_state_dict(lm())
+        sd2["rogue.weight"] = np.zeros(3, np.float32)
+        with pytest.raises(KeyError, match="unexpected"):
+            import_lm_state_dict(lm(), sd2)
+        import_lm_state_dict(lm(), sd2, strict=False)  # tolerated
+
+    def test_non_strict_loads_intersection(self):
+        """Tied-embedding checkpoints (no lm_head.weight) load under
+        strict=False; the model keeps its own head."""
+        src, dst = lm(), lm()
+        sd = export_lm_state_dict(src)
+        sd.pop("lm_head.weight")
+        sd.pop("lm_head.bias")
+        head_before = np.asarray(
+            export_lm_state_dict(dst)["lm_head.weight"])
+        import_lm_state_dict(dst, sd, strict=False)
+        out = export_lm_state_dict(dst)
+        np.testing.assert_array_equal(out["lm_head.weight"], head_before)
+        np.testing.assert_array_equal(out["embedding.weight"],
+                                      sd["embedding.weight"])
+
+    def test_failed_load_leaves_model_untouched(self):
+        """Shape validation happens before ANY assignment."""
+        dst = lm()
+        before = export_lm_state_dict(dst)
+        bad = export_lm_state_dict(lm())
+        bad["lm_head.weight"] = np.zeros((V + 1, E), np.float32)
+        with pytest.raises(ValueError, match="shape"):
+            import_lm_state_dict(dst, bad)
+        after = export_lm_state_dict(dst)
+        for k in before:
+            np.testing.assert_array_equal(after[k], before[k])
+
+    def test_shape_mismatch_rejected(self):
+        sd = export_lm_state_dict(lm())
+        sd["lm_head.weight"] = np.zeros((V + 1, E), np.float32)
+        with pytest.raises(ValueError, match="shape"):
+            import_lm_state_dict(lm(), sd)
+
+    def test_moe_rejected(self):
+        with pytest.raises(ValueError, match="MoE"):
+            export_lm_state_dict(lm(moe_experts=2))
+
+
+class TestTorchParity:
+    def test_layer_forward_matches_torch(self):
+        """Our exported weights, loaded into torch's TransformerEncoderLayer,
+        produce the same output (pre-norm, gelu, causal mask)."""
+        import torch
+
+        model = lm()
+        sd = export_lm_state_dict(model)
+        # activation must match our tanh-approximate gelu (jax.nn.gelu
+        # default); torch's "gelu" string means the exact erf form
+        tl = torch.nn.TransformerEncoderLayer(
+            d_model=E, nhead=H, dim_feedforward=F, dropout=0.0,
+            activation=lambda x: torch.nn.functional.gelu(
+                x, approximate="tanh"),
+            batch_first=True, norm_first=True)
+        with torch.no_grad():
+            for name, t_param in tl.named_parameters():
+                t_param.copy_(torch.from_numpy(
+                    sd[f"encoder.layers.0.{name}"]))
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 6, E).astype(np.float32)
+        mask = torch.triu(torch.full((6, 6), float("-inf")), diagonal=1)
+        with torch.no_grad():
+            want = tl(torch.from_numpy(x), src_mask=mask).numpy()
+        enc = [m for m in model.modules()
+               if type(m).__name__ == "TransformerEncoderLayer"][0]
+        got = np.asarray(enc.evaluate_mode().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(got, want, atol=2e-5)
