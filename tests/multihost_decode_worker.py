@@ -1,0 +1,69 @@
+"""Worker for the multi-host data-parallel DECODE test (not a pytest file).
+
+Usage: python multihost_decode_worker.py <pid> <nproc> <port> <outdir>
+
+Each process gets 2 virtual CPU devices; ``generate(mesh=...)`` runs with
+the batch (and every KV-cache buffer) sharded over a ``data`` axis that
+spans the process boundary — KV-cached inference on a real multi-host
+topology. Each process writes ITS OWN batch rows; the pytest side checks
+them against a single-process oracle.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    pid, nproc, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                                sys.argv[3], sys.argv[4])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["BIGDL_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["BIGDL_NUM_PROCESSES"] = str(nproc)
+    os.environ["BIGDL_PROCESS_ID"] = str(pid)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu.models import transformer
+    from bigdl_tpu.models.generation import generate
+    from bigdl_tpu.parallel.mesh import MeshTopology
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.rng import manual_seed
+
+    Engine.init()
+    n_dev = jax.device_count()
+    assert n_dev == 2 * nproc, (n_dev, nproc)
+
+    manual_seed(99)  # identical weights in every process (and the oracle)
+    model = transformer.build_lm(40, 16, 2, 32, num_layers=1, max_len=32)
+
+    b, s0, new = n_dev, 4, 6
+    rng = np.random.default_rng(3)
+    prompt_full = rng.integers(1, 41, (b, s0)).astype(np.float32)
+
+    mesh = MeshTopology(data=n_dev).build()
+    sharding = NamedSharding(mesh, P("data"))
+    rows_per_proc = b // nproc
+    local = prompt_full[pid * rows_per_proc:(pid + 1) * rows_per_proc]
+    prompt = jax.make_array_from_process_local_data(sharding, local,
+                                                    prompt_full.shape)
+
+    out = generate(model, prompt, new, greedy=True, mesh=mesh)
+    jax.block_until_ready(out)
+    mine = np.concatenate(
+        [np.asarray(sh.data) for sh in
+         sorted(out.addressable_shards, key=lambda sh: sh.index[0].start)],
+        axis=0)
+    np.savez(os.path.join(outdir, f"decode_rows_{pid}.npz"), rows=mine)
+    print(f"worker {pid}: OK rows {mine.shape}")
+
+
+if __name__ == "__main__":
+    main()
