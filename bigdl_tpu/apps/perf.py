@@ -51,6 +51,17 @@ def _build_model(name: str, fused_head: bool = True):
             _TRANSFORMER_VOCAB, 256, 8, 1024, num_layers=4, max_len=2048,
             fused_head=fused_head),
             (512,), _TRANSFORMER_VOCAB, _TRANSFORMER_VOCAB, True),
+        # realistic-scale LMs (GPT-2-small / GPT-2-medium shaped): big
+        # matmuls put the MXU in charge — measured 59.7% (b=8) / 52.6%
+        # (b=4) MFU on a v5e chip (PERF.md round 3), past the north star
+        "transformer_134m": lambda: (transformer.build_lm(
+            _TRANSFORMER_VOCAB, 768, 12, 3072, num_layers=12, max_len=1024,
+            fused_head=fused_head),
+            (1024,), _TRANSFORMER_VOCAB, _TRANSFORMER_VOCAB, True),
+        "transformer_368m": lambda: (transformer.build_lm(
+            _TRANSFORMER_VOCAB, 1024, 16, 4096, num_layers=24, max_len=1024,
+            fused_head=fused_head),
+            (1024,), _TRANSFORMER_VOCAB, _TRANSFORMER_VOCAB, True),
     }
     if name not in builders:
         raise SystemExit(f"unknown model {name}; one of {sorted(builders)}")
