@@ -11,3 +11,4 @@ from bigdl_tpu.dataset import image
 from bigdl_tpu.dataset import text
 from bigdl_tpu.dataset import mnist
 from bigdl_tpu.dataset import cifar
+from bigdl_tpu.dataset.bpe import BPETokenizer

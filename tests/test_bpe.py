@@ -1,0 +1,100 @@
+"""Byte-level BPE tokenizer: training, roundtrip, determinism."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset.bpe import BPETokenizer, _to_words
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the quick brown fox is quick and the dog is lazy",
+    "pack my box with five dozen liquor jugs",
+    "how quickly daft jumping zebras vex the lazy dog",
+]
+
+
+class TestWords:
+    def test_space_prefix_roundtrip(self):
+        for t in ("a b  c", " leading", "trailing ", "one", "",
+                  "tabs\tand\nnewlines stay", "unicode héllo ★"):
+            words = _to_words(t)
+            assert b"".join(words) == t.encode("utf-8")
+
+
+class TestTrainEncodeDecode:
+    def test_classic_merge_example(self):
+        # "aaab" x4: the most frequent pair is (a, a)
+        tok = BPETokenizer.train(["aaab aaab aaab aaab"], vocab_size=258,
+                                 min_freq=2)
+        assert tok.merges[0] == (ord("a"), ord("a"))
+
+    def test_exact_roundtrip(self):
+        tok = BPETokenizer.train(CORPUS, vocab_size=400)
+        for t in CORPUS + ["completely unseen words zzz öäü",
+                           "the the the", ""]:
+            ids = tok.encode(t)
+            assert tok.decode(ids) == t
+            assert all(1 <= i <= tok.vocab_size for i in ids)
+
+    def test_compression(self):
+        tok = BPETokenizer.train(CORPUS, vocab_size=500, min_freq=2)
+        text = CORPUS[0]
+        assert len(tok.encode(text)) < len(text.encode())  # beats raw bytes
+
+    def test_deterministic(self):
+        a = BPETokenizer.train(CORPUS, vocab_size=300)
+        b = BPETokenizer.train(list(CORPUS), vocab_size=300)
+        assert a.merges == b.merges
+
+    def test_vocab_bound_and_min_freq(self):
+        tok = BPETokenizer.train(CORPUS, vocab_size=280)
+        assert 256 < tok.vocab_size <= 280
+        rare = BPETokenizer.train(["xy"], vocab_size=10_000, min_freq=2)
+        assert rare.vocab_size == 256  # nothing repeats twice
+        with pytest.raises(ValueError):
+            BPETokenizer.train(CORPUS, vocab_size=100)
+
+    def test_save_load(self, tmp_path):
+        tok = BPETokenizer.train(CORPUS, vocab_size=300)
+        tok.save(str(tmp_path / "bpe.bin"))
+        clone = BPETokenizer.load(str(tmp_path / "bpe.bin"))
+        assert clone.merges == tok.merges
+        assert clone.encode(CORPUS[0]) == tok.encode(CORPUS[0])
+
+    def test_eos_id_reserved(self):
+        tok = BPETokenizer.train(CORPUS, vocab_size=300)
+        assert tok.eos_id == tok.vocab_size + 1
+        assert tok.decode(tok.encode("hi") + [tok.eos_id]) == "hi"
+
+
+class TestTextLmEndToEnd:
+    def test_train_tiny_lm_and_generate_text(self):
+        """The full modern-LM loop: BPE-tokenize real text, train the
+        causal LM a few steps, generate, decode back to a string."""
+        import jax.numpy as jnp
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+        from bigdl_tpu.models import transformer, generate
+        from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+        tok = BPETokenizer.train(CORPUS, vocab_size=300)
+        s = 12
+        stream = []
+        for t in CORPUS * 4:
+            stream.extend(tok.encode(t) + [tok.eos_id])
+        samples = [Sample(np.asarray(stream[i:i + s], np.float32),
+                          np.asarray(stream[i + 1:i + 1 + s], np.float32))
+                   for i in range(0, len(stream) - s - 1, s)]
+        model = transformer.build_lm(tok.eos_id, 32, 4, 64, num_layers=1,
+                                     max_len=64, fused_head=True)
+        opt = Optimizer(model, DataSet.array(samples).transform(
+            SampleToBatch(batch_size=8)), nn.FusedLMHeadCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_epoch(1))
+        trained = opt.optimize()
+
+        prompt = jnp.asarray([[float(t) for t in tok.encode("the quick")]])
+        out = generate(trained, prompt, 10, greedy=True, eos_id=tok.eos_id)
+        text = tok.decode([int(t) for t in np.asarray(out)[0]])
+        assert text.startswith("the quick")
+        assert isinstance(text, str)
