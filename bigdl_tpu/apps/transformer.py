@@ -41,6 +41,35 @@ def _synthetic_corpus(n: int, seq_len: int, vocab: int, seed: int = 17):
     return samples
 
 
+def _text_corpus(args):
+    """BPE-tokenize ``--textFile`` into next-token samples; the learned
+    tokenizer is saved beside the checkpoint so ``generate --tokenizer``
+    can decode real text."""
+    from bigdl_tpu.dataset.bpe import BPETokenizer
+    if args.bpeVocab < 256:
+        raise SystemExit("--bpeVocab must be >= 256 (the byte alphabet)")
+    with open(args.textFile, encoding="utf-8") as f:
+        lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+    tok = BPETokenizer.train(lines, vocab_size=args.bpeVocab)
+    stream = []
+    for ln in lines:
+        stream.extend(tok.encode(ln) + [tok.eos_id])
+    s = args.seqLen
+    samples = [Sample(np.asarray(stream[i:i + s], np.float32),
+                      np.asarray(stream[i + 1:i + 1 + s], np.float32))
+               for i in range(0, len(stream) - s, s)]
+    if not samples:
+        raise SystemExit(f"--textFile too small for --seqLen {s} "
+                         f"({len(stream)} tokens)")
+    if args.checkpoint:
+        import os as _os
+        _os.makedirs(args.checkpoint, exist_ok=True)
+        tok.save(f"{args.checkpoint}/tokenizer.bigdl")
+    print(f"text corpus: {len(stream)} tokens, BPE vocab {tok.vocab_size} "
+          f"(+eos {tok.eos_id}), {len(samples)} samples", file=sys.stderr)
+    return samples, tok.eos_id
+
+
 def train(argv):
     parser = train_parser("bigdl_tpu.apps.transformer train",
                           default_batch=8, default_epochs=2, default_lr=3e-3)
@@ -66,13 +95,23 @@ def train(argv):
                         help="LMHead + FusedLMHeadCriterion tail: the "
                         "(B,S,V) logits never materialise (plain data-"
                         "parallel path only)")
+    parser.add_argument("--textFile", default=None,
+                        help="train on REAL text: BPE-tokenize this file "
+                        "(--bpeVocab merges), save the tokenizer next to "
+                        "--checkpoint; --vocab is then derived")
+    parser.add_argument("--bpeVocab", type=int, default=512,
+                        help="BPE vocab size (>= 256; byte alphabet + "
+                        "merges)")
     args = parser.parse_args(argv)
 
     if args.contextParallel and args.tensorParallel > 1:
         raise SystemExit("--contextParallel and --tensorParallel are "
                          "separate modes; pick one")
-    samples = _synthetic_corpus(max(args.synthetic_size, args.batchSize),
-                                args.seqLen, args.vocab)
+    if args.textFile:
+        samples, args.vocab = _text_corpus(args)
+    else:
+        samples = _synthetic_corpus(max(args.synthetic_size, args.batchSize),
+                                    args.seqLen, args.vocab)
     ds = DataSet.array(samples,
                        distributed=args.tensorParallel > 1).transform(
         SampleToBatch(batch_size=args.batchSize))
@@ -248,6 +287,10 @@ def generate_cmd(argv) -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--int8", action="store_true",
                     help="decode with the int8 weight-only quantized twin")
+    ap.add_argument("--tokenizer", default=None,
+                    help="BPE tokenizer path (from train --textFile): "
+                    "--prompt is then TEXT and the continuation prints "
+                    "as text")
     args = ap.parse_args(argv)
 
     import jax
@@ -263,7 +306,16 @@ def generate_cmd(argv) -> None:
         model = train(["-b", "8", "--seqLen", "32", "--maxEpoch", "1"])
     if args.int8:
         model = nn.quantize_model(model)
-    prompt = jnp.asarray([[float(t) for t in args.prompt.split(",")]])
+    tok = None
+    if args.tokenizer:
+        from bigdl_tpu.dataset.bpe import BPETokenizer
+        tok = BPETokenizer.load(args.tokenizer)
+        ids = [float(t) for t in tok.encode(args.prompt)]
+        if args.eosId is None:
+            args.eosId = tok.eos_id
+    else:
+        ids = [float(t) for t in args.prompt.split(",")]
+    prompt = jnp.asarray([ids])
     out = generate(model, prompt, args.maxNewTokens,
                    temperature=args.temperature, top_k=args.topK,
                    top_p=args.topP, greedy=args.greedy,
@@ -274,8 +326,12 @@ def generate_cmd(argv) -> None:
                    key=jax.random.PRNGKey(args.seed))
     ids = np.asarray(out[0]).astype(int).tolist()  # one host transfer
     n0 = prompt.shape[1]
-    print("prompt:      ", ids[:n0])
-    print("continuation:", ids[n0:])
+    if tok is not None:
+        print("prompt:      ", repr(tok.decode(ids[:n0])))
+        print("continuation:", repr(tok.decode(ids[n0:])))
+    else:
+        print("prompt:      ", ids[:n0])
+        print("continuation:", ids[n0:])
 
 
 def main() -> None:

@@ -9,8 +9,9 @@ provides classic byte-level BPE (Sennrich-style merges over UTF-8 bytes):
   (no OOV, exact decode roundtrip);
 - training greedily merges the most frequent adjacent symbol pair until
   ``vocab_size`` is reached (ties break deterministically);
-- words are whitespace-split with the space carried as a prefix byte
-  (GPT-style), so merges never cross word boundaries but decoding
+- words are split on the ASCII SPACE byte only, with the space carried
+  as a word-prefix byte (GPT-style): merges never cross a space, other
+  whitespace (tabs/newlines) stays inside words, and decoding
   reconstructs the exact original string.
 
 Token ids follow the framework's 1-based convention (``LookupTable``):
@@ -29,8 +30,9 @@ Pair = Tuple[int, int]
 
 
 def _to_words(text: str) -> List[bytes]:
-    """Whitespace-split with the separating space kept as a word prefix,
-    so ``b"".join(words) == text.encode()`` exactly."""
+    """Split on the ASCII space byte (kept as a word prefix), so
+    ``b"".join(words) == text.encode()`` exactly; tabs/newlines remain
+    inside words."""
     raw = text.encode("utf-8")
     words: List[bytes] = []
     start = 0

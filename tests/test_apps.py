@@ -187,6 +187,24 @@ class TestPerfHarness:
         transformer.train(["-b", "8", "--seqLen", "32", "-e", "1",
                            "--synthetic-size", "16", "--moeExperts", "4"])
 
+    def test_transformer_text_lm_end_to_end(self, tmp_path, capsys):
+        """--textFile: BPE-tokenize real text, train, generate TEXT back."""
+        from bigdl_tpu.apps import transformer
+        corpus = tmp_path / "corpus.txt"
+        corpus.write_text("the quick brown fox jumps over the lazy dog\n"
+                          "the quick brown fox is quick and lazy\n" * 4)
+        ck = str(tmp_path / "ck")
+        transformer.train(["--textFile", str(corpus), "--bpeVocab", "280",
+                           "--seqLen", "8", "-b", "4", "-e", "2",
+                           "--checkpoint", ck, "--fusedHead"])
+        transformer.generate_cmd(["--model", f"{ck}/model_final",
+                                  "--tokenizer", f"{ck}/tokenizer.bigdl",
+                                  "--prompt", "the quick",
+                                  "--maxNewTokens", "4", "--greedy"])
+        out = capsys.readouterr().out
+        assert "prompt:       'the quick'" in out
+        assert "continuation:" in out
+
     def test_transformer_generate_subcommand(self, tmp_path, capsys):
         from bigdl_tpu.apps import transformer
         ck = str(tmp_path / "ck")

@@ -84,7 +84,7 @@ class TestTextLmEndToEnd:
             stream.extend(tok.encode(t) + [tok.eos_id])
         samples = [Sample(np.asarray(stream[i:i + s], np.float32),
                           np.asarray(stream[i + 1:i + 1 + s], np.float32))
-                   for i in range(0, len(stream) - s - 1, s)]
+                   for i in range(0, len(stream) - s, s)]
         model = transformer.build_lm(tok.eos_id, 32, 4, 64, num_layers=1,
                                      max_len=64, fused_head=True)
         opt = Optimizer(model, DataSet.array(samples).transform(
