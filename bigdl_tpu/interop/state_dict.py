@@ -40,7 +40,8 @@ import numpy as np
 
 from bigdl_tpu.nn.attention import (LayerNorm, MultiHeadAttention,
                                     TransformerEncoder)
-from bigdl_tpu.nn.linear import LMHead, Linear, LookupTable
+from bigdl_tpu.nn.linear import (LMHead, Linear, LookupTable,
+                                 TiedLMHead)
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.nn.recurrent import TimeDistributed
 
@@ -50,7 +51,8 @@ def _lm_parts(model: Module):
     lookups = [m for m in model.modules() if isinstance(m, LookupTable)]
     encoders = [m for m in model.modules()
                 if isinstance(m, TransformerEncoder)]
-    heads = [m for m in model.modules() if isinstance(m, LMHead)]
+    heads = [m for m in model.modules()
+             if isinstance(m, (LMHead, TiedLMHead))]
     if not heads:
         heads = [td.inner for td in model.modules()
                  if isinstance(td, TimeDistributed)
@@ -94,6 +96,10 @@ def _named_params(model: Module) -> List[Tuple[str, Module, str]]:
     if enc.final_norm is not None:
         out.append(("encoder.norm.weight", enc.final_norm, "weight"))
         out.append(("encoder.norm.bias", enc.final_norm, "bias"))
+    if isinstance(head, TiedLMHead):
+        # GPT-2 convention: tied checkpoints carry NO lm_head.* keys — the
+        # head IS embedding.weight (already emitted above)
+        return out
     out.append(("lm_head.weight", head, "weight"))
     if head.with_bias:
         out.append(("lm_head.bias", head, "bias"))

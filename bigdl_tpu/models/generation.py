@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.nn.attention import MultiHeadAttention, PositionalEncoding
-from bigdl_tpu.nn.linear import LMHead
+from bigdl_tpu.nn.linear import LMHead, TiedLMHead
 from bigdl_tpu.nn.module import Module, functional_apply
 from bigdl_tpu.nn.recurrent import TimeDistributed
 
@@ -78,7 +78,7 @@ def _decode_modules(model: Module):
     # one token (TimeDistributed slices likewise: in an autoregressive LM
     # it only ever appears as the vocab head)
     heads = [m for m in model.modules()
-             if isinstance(m, (LMHead, TimeDistributed))]
+             if isinstance(m, (LMHead, TiedLMHead, TimeDistributed))]
     if not mhas:
         raise ValueError("generate() needs a model with MultiHeadAttention "
                          "layers (see models/transformer.build_lm)")

@@ -22,7 +22,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
              seq_mode: str = "ring",
              seq_layout: str = "contiguous",
              moe_experts: int = 0, moe_k: int = 2,
-             fused_head: bool = False) -> nn.Sequential:
+             fused_head: bool = False,
+             tie_embeddings: bool = False) -> nn.Sequential:
     """Causal LM: 1-based token ids (N, T) -> log-probs (N, T, vocab).
 
     ``seq_axis="seq"`` shards every attention layer over the mesh sequence
@@ -37,9 +38,15 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
     tail for ``nn.LMHead``; train with ``nn.FusedLMHeadCriterion`` and the
     (B, S, vocab) logits are never materialised (``ops/lm_head_ce.py``).
     Eval/predict/generate still see log-probs (LMHead computes them in
-    eval mode); the head weight keeps Linear's (V, E) layout."""
+    eval mode); the head weight keeps Linear's (V, E) layout.
+
+    ``tie_embeddings=True`` (GPT-2-style) shares ONE (V, E) matrix between
+    the embedding and the vocab projection (``nn.TiedLMHead`` — saves V*E
+    params and its gradient combines both uses); implies the fused-CE
+    training path, so train with ``nn.FusedLMHeadCriterion``."""
+    embed = nn.LookupTable(vocab_size, embed_dim)
     m = (nn.Sequential()
-         .add(nn.LookupTable(vocab_size, embed_dim))
+         .add(embed)
          .add(nn.PositionalEncoding(embed_dim, max_len, dropout))
          .add(nn.TransformerEncoder(num_layers, embed_dim, num_heads,
                                     ffn_dim, dropout=dropout, causal=True,
@@ -47,6 +54,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
                                     seq_layout=seq_layout,
                                     moe_experts=moe_experts,
                                     moe_k=moe_k)))
+    if tie_embeddings:
+        return m.add(nn.TiedLMHead(embed))
     if fused_head:
         return m.add(nn.LMHead(embed_dim, vocab_size))
     return (m.add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size)))

@@ -29,7 +29,7 @@ from bigdl_tpu.nn.activation import (
 )
 from bigdl_tpu.nn.linear import (
     Linear, Bilinear, Cosine, Euclidean, MM, MV, DotProduct, LookupTable,
-    Add, CAdd, Mul, CMul, Scale, LMHead,
+    Add, CAdd, Mul, CMul, Scale, LMHead, TiedLMHead,
 )
 from bigdl_tpu.nn.quantized import (
     quantize_model, quantize_module, quantize_array, QuantizedLinear,

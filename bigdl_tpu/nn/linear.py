@@ -306,3 +306,55 @@ class LMHead(Module):
 
     def __repr__(self):
         return f"LMHead({self.input_size} -> {self.vocab_size})"
+
+
+class TiedLMHead(Module):
+    """Vocab projection TIED to the embedding table (GPT-2-style).
+
+    Holds a plain reference (NOT a registered child, so the table appears
+    exactly once in the parameter tree, under the LookupTable) and reads
+    ``embed.weight`` at forward time. Under ``functional_apply`` that read
+    sees the tracer loaded into the embedding, so the loss depends on ONE
+    parameter through both uses and autodiff returns the combined
+    gradient — tying needs no extra machinery. deepcopy/pickle preserve
+    the sharing (both paths to the LookupTable live in one object graph).
+
+    Training mode emits the fused-CE Table ``(hidden, weight)`` (pair with
+    ``FusedLMHeadCriterion``); eval mode computes log-probs, slicing to
+    the last position while decoding (``models.generate``).
+    """
+
+    _decode = False
+
+    def __init__(self, embed: LookupTable):
+        super().__init__()
+        if embed.max_norm != float("inf"):
+            raise ValueError(
+                "cannot tie to a max-norm LookupTable: the embedding path "
+                "renormalises per forward, so the head would project with "
+                "a different matrix than the one that embeds")
+        # bypass Module.__setattr__ so the embed is NOT registered as a
+        # child module (its weight must stay unique in the parameter tree)
+        object.__setattr__(self, "embed_ref", embed)
+
+    def enable_decode(self) -> "TiedLMHead":
+        self._decode = True
+        return self
+
+    def disable_decode(self) -> "TiedLMHead":
+        self._decode = False
+        return self
+
+    def update_output(self, input):
+        from bigdl_tpu.utils.table import Table
+        w = self.embed_ref.weight  # (V, E): the LIVE embedding parameter
+        if self.training:
+            return Table(input, w)
+        if self._decode:
+            input = input[:, -1:]
+        y = jnp.matmul(match_compute(input, w), w.T)
+        return jax.nn.log_softmax(y, axis=-1)
+
+    def __repr__(self):
+        v, e = self.embed_ref.weight.shape
+        return f"TiedLMHead({e} -> {v}, tied)"

@@ -169,3 +169,72 @@ class TestCriterionAndHead:
             return losses
 
         np.testing.assert_allclose(run(True), run(False), rtol=2e-4)
+
+
+class TestTiedEmbeddings:
+    def test_one_shared_matrix(self):
+        m = transformer.build_lm(V, E, 2, 16, num_layers=1, max_len=16,
+                                 tie_embeddings=True)
+        untied = transformer.build_lm(V, E, 2, 16, num_layers=1, max_len=16,
+                                      fused_head=True)
+        assert m.n_parameters() == untied.n_parameters() - V * E - V
+
+    def test_gradient_combines_both_uses(self):
+        """d loss/d table must include the embedding AND head paths: it
+        differs from the untied head-gradient alone."""
+        from bigdl_tpu.nn.module import functional_apply
+        m = transformer.build_lm(V, E, 2, 16, num_layers=1, max_len=16,
+                                 tie_embeddings=True)
+        crit = nn.FusedLMHeadCriterion(chunk=16)
+        params, buffers = m.functional_state()
+        x = jnp.asarray([[3.0, 5.0, 7.0]])
+        y = jnp.asarray([[5.0, 7.0, 2.0]])
+
+        def loss(p):
+            out, _ = functional_apply(m, p, buffers, x, training=True)
+            return crit.apply(out, y)
+
+        g = jax.grad(loss)(params)
+        table_grad = g["0"]["weight"]  # Sequential child 0 = LookupTable
+        # head path touches every vocab row; rows NOT in the prompt get
+        # gradient only via the head -> nonzero beyond the embedded rows
+        untouched = np.asarray(table_grad)[10:]  # rows 11.. never embedded
+        assert np.abs(untouched).max() > 0
+
+    def test_tied_generate_and_eval(self):
+        m = transformer.build_lm(V, E, 2, 16, num_layers=1, max_len=32,
+                                 tie_embeddings=True)
+        from bigdl_tpu.models.generation import generate
+        out = generate(m, jnp.ones((1, 3)), 5, greedy=True)
+        assert out.shape == (1, 8)
+        logp = m.evaluate_mode().predict(jnp.ones((1, 4)))
+        np.testing.assert_allclose(np.asarray(jnp.exp(logp).sum(-1)), 1.0,
+                                   rtol=1e-5)
+
+    def test_tying_survives_clone_and_pickle(self):
+        import pickle
+        m = transformer.build_lm(V, E, 2, 16, num_layers=1, max_len=16,
+                                 tie_embeddings=True)
+        for copy_fn in (lambda x: x.clone_module(),
+                        lambda x: pickle.loads(pickle.dumps(x))):
+            c = copy_fn(m)
+            head = [mm for mm in c.modules()
+                    if type(mm).__name__ == "TiedLMHead"][0]
+            emb = [mm for mm in c.modules()
+                   if type(mm).__name__ == "LookupTable"][0]
+            assert head.embed_ref is emb  # sharing preserved
+
+    def test_tied_trains_e2e(self):
+        from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+        from bigdl_tpu.optim import SGD, Optimizer, Trigger
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randint(1, V + 1, (6,)).astype(np.float32),
+                          rng.randint(1, V + 1, (6,)).astype(np.float32))
+                   for _ in range(8)]
+        m = transformer.build_lm(V, E, 2, 16, num_layers=1, max_len=16,
+                                 tie_embeddings=True)
+        opt = Optimizer(m, DataSet.array(samples).transform(
+            SampleToBatch(batch_size=4)), nn.FusedLMHeadCriterion(chunk=16))
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_iteration(3))
+        opt.optimize()

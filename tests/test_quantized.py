@@ -124,3 +124,16 @@ class TestQuantizedModel:
         qbufs = [b for m in qmodel.modules()
                  for n, b in m._buffers.items() if n.endswith("_q")]
         assert qbufs and all(b.dtype == jnp.int8 for b in qbufs)
+
+    def test_tied_lm_quantizes(self):
+        """TiedLMHead reads the (quantized) embedding through its .weight
+        property, so the tied model serves int8 end-to-end."""
+        model = transformer.build_lm(50, 32, 4, 64, num_layers=1,
+                                     max_len=64, tie_embeddings=True)
+        qmodel = quantize_model(model)
+        assert qmodel.parameters() == []
+        out = generate(qmodel, jnp.asarray([[3.0, 7.0]]), 6, greedy=True)
+        assert np.asarray(out).shape == (1, 8)
+        lp = np.asarray(model.evaluate_mode().predict(jnp.ones((1, 3))))
+        qlp = np.asarray(qmodel.predict(jnp.ones((1, 3))), np.float32)
+        assert np.abs(lp - qlp).max() < 0.5

@@ -121,3 +121,28 @@ class TestTorchParity:
                if type(m).__name__ == "TransformerEncoderLayer"][0]
         got = np.asarray(enc.evaluate_mode().forward(jnp.asarray(x)))
         np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+class TestTiedModels:
+    def test_tied_export_omits_lm_head(self):
+        tied = transformer.build_lm(V, E, 2, F, num_layers=1, max_len=16,
+                                    tie_embeddings=True)
+        sd = export_lm_state_dict(tied)
+        assert "lm_head.weight" not in sd  # GPT-2 tied convention
+        assert "embedding.weight" in sd
+
+    def test_tied_roundtrip(self):
+        src = transformer.build_lm(V, E, 2, F, num_layers=1, max_len=16,
+                                   tie_embeddings=True)
+        dst = transformer.build_lm(V, E, 2, F, num_layers=1, max_len=16,
+                                   tie_embeddings=True)
+        import_lm_state_dict(dst, export_lm_state_dict(src))
+        x = jnp.asarray([[3.0, 5.0]])
+        np.testing.assert_allclose(
+            np.asarray(dst.evaluate_mode().predict(x)),
+            np.asarray(src.evaluate_mode().predict(x)), atol=1e-6)
+
+    def test_max_norm_tie_rejected(self):
+        from bigdl_tpu import nn
+        with pytest.raises(ValueError, match="max-norm"):
+            nn.TiedLMHead(nn.LookupTable(10, 4, max_norm=1.0))
