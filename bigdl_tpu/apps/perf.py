@@ -32,7 +32,7 @@ def _build_model(name: str, fused_head: bool = True):
     +23% on chip, PERF.md round 3) or TimeDistributedCriterion(ClassNLL)
     with ``fused_head=False`` (the causal LM)."""
     from bigdl_tpu.models import (inception, lenet, resnet, rnn, transformer,
-                                  vgg)
+                                  vgg, vit)
     builders = {
         "inception_v1": lambda: (inception.build(1000), (224, 224, 3), 1000,
                                  0, False),
@@ -45,6 +45,7 @@ def _build_model(name: str, fused_head: bool = True):
         "resnet50": lambda: (resnet.build(1000, depth=50), (224, 224, 3),
                              1000, 0, False),
         "lenet5": lambda: (lenet.build(10), (28, 28, 1), 10, 0, False),
+        "vit_s16": lambda: (vit.build(1000), (224, 224, 3), 1000, 0, False),
         "lstm": lambda: (rnn.build_classifier(_LSTM_VOCAB, 128, 128, 20),
                          (500,), 20, _LSTM_VOCAB, False),
         "transformer": lambda: (transformer.build_lm(

@@ -7,4 +7,5 @@ from bigdl_tpu.models import inception
 from bigdl_tpu.models import autoencoder
 from bigdl_tpu.models import rnn
 from bigdl_tpu.models import transformer
+from bigdl_tpu.models import vit
 from bigdl_tpu.models.generation import generate

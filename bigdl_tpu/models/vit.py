@@ -1,0 +1,46 @@
+"""Vision Transformer — beyond-reference model family built ENTIRELY from
+the existing zoo (patch embedding = strided ``SpatialConvolution``,
+``TransformerEncoder`` without the causal mask, mean-pool head).
+
+The reference's newest vision model is Inception-v2 (2016); ViT shows the
+attention stack introduced for the LM doubles as a modern vision family
+with zero new layer code. NHWC in (B, H, W, C) like every conv model here;
+1-based labels out (LogSoftMax + ClassNLL), so the standard Optimizer /
+Top1Accuracy tooling applies unchanged.
+
+Shapes follow ViT-S/16-style conventions; ``build(1000)`` is ViT-S/16
+(22M params). Mean pooling replaces the CLS token (simpler, equally
+standard — no sequence-position bookkeeping), and positions are learned
+(``CAdd`` over the token grid), matching the original ViT recipe.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def build(class_num: int, image_size: int = 224, patch_size: int = 16,
+          embed_dim: int = 384, num_heads: int = 6, ffn_dim: int = 1536,
+          num_layers: int = 12, dropout: float = 0.0) -> nn.Sequential:
+    """ViT classifier: (B, H, W, C) NHWC images -> (B, class_num) log-probs.
+
+    Defaults are ViT-S/16. The patch embedding is one strided conv (the
+    standard trick: conv k=p, s=p == unfold+linear, and it lands on the
+    MXU as a single big matmul).
+    """
+    if image_size % patch_size != 0:
+        raise ValueError(f"image_size {image_size} must be a multiple of "
+                         f"patch_size {patch_size}")
+    n_patches = (image_size // patch_size) ** 2
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(3, embed_dim, patch_size, patch_size,
+                                       patch_size, patch_size))
+            .add(nn.Reshape((n_patches, embed_dim), batch_mode=True))
+            # learned positions: one bias per (token, channel)
+            .add(nn.CAdd((n_patches, embed_dim)))
+            .add(nn.TransformerEncoder(num_layers, embed_dim, num_heads,
+                                       ffn_dim, dropout=dropout,
+                                       causal=False))
+            .add(nn.Mean(dimension=2))          # token mean-pool (1-based dim)
+            .add(nn.Linear(embed_dim, class_num))
+            .add(nn.LogSoftMax()))

@@ -356,5 +356,7 @@ class TiedLMHead(Module):
         return jax.nn.log_softmax(y, axis=-1)
 
     def __repr__(self):
-        v, e = self.embed_ref.weight.shape
-        return f"TiedLMHead({e} -> {v}, tied)"
+        # n_index/n_output avoid dequantizing a quantized table just to
+        # print the shape
+        return (f"TiedLMHead({self.embed_ref.n_output} -> "
+                f"{self.embed_ref.n_index}, tied)")

@@ -202,3 +202,49 @@ class TestTransformerLM:
         # log-probs: rows sum to ~1 in prob space
         s = np.exp(np.asarray(out)).sum(-1)
         np.testing.assert_allclose(s, np.ones_like(s), rtol=1e-4)
+
+
+class TestViT:
+    def test_shapes_and_distribution(self):
+        import jax.numpy as jnp
+        from bigdl_tpu.models import vit
+        m = vit.build(10, image_size=32, patch_size=8, embed_dim=32,
+                      num_heads=4, ffn_dim=64, num_layers=2)
+        out = m.predict(jnp.ones((2, 32, 32, 3)))
+        assert out.shape == (2, 10)
+        assert m.predict(jnp.ones((1, 32, 32, 3))).shape == (1, 10)  # b=1
+        np.testing.assert_allclose(np.asarray(jnp.exp(out).sum(-1)), 1.0,
+                                   rtol=1e-5)
+
+    def test_vit_s16_param_count(self):
+        from bigdl_tpu.models import vit
+        m = vit.build(1000)
+        assert abs(m.n_parameters() - 22.0e6) < 0.5e6  # ViT-S/16 ~22M
+
+    def test_bad_patch_size_rejected(self):
+        from bigdl_tpu.models import vit
+        with pytest.raises(ValueError, match="multiple"):
+            vit.build(10, image_size=30, patch_size=8)
+
+    def test_trains_on_synthetic(self):
+        import jax.numpy as jnp
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+        from bigdl_tpu.models import vit
+        from bigdl_tpu.optim import Optimizer, SGD, Trigger
+        rng = np.random.RandomState(0)
+        # two linearly separable classes by channel mean
+        samples = [Sample((rng.rand(16, 16, 3) * 0.1
+                           + (0.8 if i % 2 else 0.0)).astype(np.float32),
+                          np.float32(1 + i % 2)) for i in range(32)]
+        m = vit.build(2, image_size=16, patch_size=8, embed_dim=16,
+                      num_heads=2, ffn_dim=32, num_layers=1)
+        opt = Optimizer(m, DataSet.array(samples).transform(
+            SampleToBatch(batch_size=8)), nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1, momentum=0.9))
+        opt.set_end_when(Trigger.max_epoch(8))
+        trained = opt.optimize()
+        x = jnp.stack([np.asarray(s.feature) for s in samples[:8]])
+        pred = np.asarray(trained.predict_class(x))
+        truth = np.asarray([1, 2] * 4)
+        assert (pred == truth).mean() >= 0.8
