@@ -23,7 +23,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
              seq_layout: str = "contiguous",
              moe_experts: int = 0, moe_k: int = 2,
              fused_head: bool = False,
-             tie_embeddings: bool = False) -> nn.Sequential:
+             tie_embeddings: bool = False,
+             rope: bool = False) -> nn.Sequential:
     """Causal LM: 1-based token ids (N, T) -> log-probs (N, T, vocab).
 
     ``seq_axis="seq"`` shards every attention layer over the mesh sequence
@@ -43,17 +44,25 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
     ``tie_embeddings=True`` (GPT-2-style) shares ONE (V, E) matrix between
     the embedding and the vocab projection (``nn.TiedLMHead`` — saves V*E
     params and its gradient combines both uses); implies the fused-CE
-    training path, so train with ``nn.FusedLMHeadCriterion``."""
+    training path, so train with ``nn.FusedLMHeadCriterion``.
+
+    ``rope=True`` replaces the additive sinusoidal PositionalEncoding with
+    rotary embeddings on q/k (relative positions; the modern standard) —
+    the PE module is dropped entirely. Not yet composable with
+    ``seq_axis`` context parallelism."""
     embed = nn.LookupTable(vocab_size, embed_dim)
-    m = (nn.Sequential()
-         .add(embed)
-         .add(nn.PositionalEncoding(embed_dim, max_len, dropout))
-         .add(nn.TransformerEncoder(num_layers, embed_dim, num_heads,
-                                    ffn_dim, dropout=dropout, causal=True,
-                                    seq_axis=seq_axis, seq_mode=seq_mode,
-                                    seq_layout=seq_layout,
-                                    moe_experts=moe_experts,
-                                    moe_k=moe_k)))
+    m = nn.Sequential().add(embed)
+    if not rope:
+        m.add(nn.PositionalEncoding(embed_dim, max_len, dropout))
+    elif dropout:
+        # keep the embedding-stream dropout the PE module would have applied
+        m.add(nn.Dropout(dropout))
+    m.add(nn.TransformerEncoder(num_layers, embed_dim, num_heads,
+                                ffn_dim, dropout=dropout, causal=True,
+                                seq_axis=seq_axis, seq_mode=seq_mode,
+                                seq_layout=seq_layout,
+                                moe_experts=moe_experts,
+                                moe_k=moe_k, rope=rope))
     if tie_embeddings:
         return m.add(nn.TiedLMHead(embed))
     if fused_head:

@@ -87,9 +87,19 @@ class MultiHeadAttention(Module):
                  dropout: float = 0.0, with_bias: bool = True,
                  causal: bool = False, block_size: int = 0,
                  seq_axis: Optional[str] = None, seq_mode: str = "ring",
-                 seq_layout: str = "contiguous"):
+                 seq_layout: str = "contiguous", rope: bool = False):
         super().__init__()
         assert embed_dim % num_heads == 0, "embed_dim must divide num_heads"
+        # rope: rotary position embeddings applied to q/k per head (the
+        # model then needs NO additive PositionalEncoding). Rotation uses
+        # absolute positions (decode_pos-offset while decoding), so cached
+        # keys carry their rotation and the q@k score is relative.
+        if rope and (embed_dim // num_heads) % 2 != 0:
+            raise ValueError("rope needs an even head_dim")
+        if rope and seq_axis is not None:
+            raise ValueError("rope is not supported with context-parallel "
+                             "attention yet (per-shard global positions)")
+        self.rope = rope
         # seq_axis: mesh axis name for context parallelism. When set, the
         # module must run inside shard_map with activations sharded
         # (B, S/P, E) on that axis; attention goes through
@@ -219,6 +229,18 @@ class MultiHeadAttention(Module):
         k = self._split_heads(self._project(key, wk, bk))
         v = self._split_heads(self._project(value, wv, bv))
 
+        if getattr(self, "rope", False):
+            if k.shape[1] != q.shape[1]:
+                raise ValueError(
+                    "rope supports self-attention only (q and k positions "
+                    "coincide); cross-attention inputs need per-tensor "
+                    "positions")
+            pos = jnp.arange(q.shape[1])
+            if self._decode:
+                pos = pos + self.decode_pos
+            q = rope_rotate(q, pos)
+            k = rope_rotate(k, pos)
+
         if self._decode:
             ctx = self._attend_decode(q, k, v)
         else:
@@ -306,7 +328,7 @@ class TransformerEncoderLayer(Module):
                  pre_norm: bool = True, causal: bool = False,
                  block_size: int = 0, seq_axis: Optional[str] = None,
                  seq_mode: str = "ring", seq_layout: str = "contiguous",
-                 moe_experts: int = 0, moe_k: int = 2):
+                 moe_experts: int = 0, moe_k: int = 2, rope: bool = False):
         super().__init__()
         from bigdl_tpu.nn.linear import Linear
         from bigdl_tpu.nn.regularization import Dropout
@@ -319,7 +341,8 @@ class TransformerEncoderLayer(Module):
                                             block_size=block_size,
                                             seq_axis=seq_axis,
                                             seq_mode=seq_mode,
-                                            seq_layout=seq_layout)
+                                            seq_layout=seq_layout,
+                                            rope=rope)
         if moe_experts:
             # MoE FFN: top-k routed expert MLPs replace the dense pair;
             # under expert parallelism the stacked expert leaves shard
@@ -378,7 +401,7 @@ class TransformerEncoder(Module):
                  pre_norm: bool = True, causal: bool = False,
                  block_size: int = 0, seq_axis: Optional[str] = None,
                  seq_mode: str = "ring", seq_layout: str = "contiguous",
-                 moe_experts: int = 0, moe_k: int = 2):
+                 moe_experts: int = 0, moe_k: int = 2, rope: bool = False):
         super().__init__()
         self.num_layers = num_layers
         for i in range(num_layers):
@@ -386,7 +409,8 @@ class TransformerEncoder(Module):
                 embed_dim, num_heads, ffn_dim, dropout=dropout,
                 activation=activation, pre_norm=pre_norm, causal=causal,
                 block_size=block_size, seq_axis=seq_axis, seq_mode=seq_mode,
-                seq_layout=seq_layout, moe_experts=moe_experts, moe_k=moe_k))
+                seq_layout=seq_layout, moe_experts=moe_experts, moe_k=moe_k,
+                rope=rope))
         self.final_norm = LayerNorm(embed_dim) if pre_norm else None
         if self.final_norm is not None:
             self.add_module("final_norm", self.final_norm)
@@ -398,3 +422,21 @@ class TransformerEncoder(Module):
         if self.final_norm is not None:
             x = self.final_norm.forward(x)
         return x
+
+
+def rope_rotate(x: jax.Array, positions: jax.Array) -> jax.Array:
+    """Rotary position embedding (RoPE, Su et al.): rotate feature pairs of
+    ``x`` (B, S, H, D) by angles proportional to absolute ``positions``
+    (S,). Because rotations compose, q@k between positions i and j depends
+    only on i - j — the relative-position property that makes RoPE the
+    modern LM standard. Applied to q/k BEFORE attention (and before the KV
+    cache write, so cached keys carry their absolute rotation)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (S, half)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)

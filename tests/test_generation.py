@@ -439,3 +439,69 @@ class TestSamplingKnobs:
                      repetition_penalty=1.5)
         with pytest.raises(ValueError, match="repetition_penalty"):
             generate(model, jnp.ones((1, 2)), 3, repetition_penalty=0.0)
+
+
+class TestRope:
+    def test_relative_shift_invariance(self):
+        """RoPE attention scores depend only on RELATIVE positions: rotating
+        q/k with positions p and p+K gives identical attention outputs."""
+        from bigdl_tpu.nn.attention import rope_rotate
+        from bigdl_tpu.ops.attention_core import dot_product_attention
+        rng = np.random.RandomState(0)
+        q, k, v = (jnp.asarray(rng.randn(1, 6, 2, 8).astype(np.float32))
+                   for _ in range(3))
+        p0 = jnp.arange(6)
+        out0 = dot_product_attention(rope_rotate(q, p0), rope_rotate(k, p0),
+                                     v, causal=True)
+        p1 = jnp.arange(6) + 37
+        out1 = dot_product_attention(rope_rotate(q, p1), rope_rotate(k, p1),
+                                     v, causal=True)
+        np.testing.assert_allclose(np.asarray(out0), np.asarray(out1),
+                                   atol=1e-5)
+
+    def test_rope_greedy_decode_parity(self):
+        """Cached decode rotates by absolute decode positions: must match
+        the full-forward oracle exactly."""
+        model = transformer.build_lm(VOCAB, 32, 4, 64, num_layers=2,
+                                     max_len=64, rope=True)
+        p = jnp.array([[3.0, 9.0, 4.0]])
+        want = greedy_no_cache(model, p, 12)
+        got = generate(model, p, 12, greedy=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_rope_trains_e2e(self):
+        from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+        from bigdl_tpu.optim import SGD, Optimizer, Trigger
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randint(1, VOCAB + 1, (8,)).astype(np.float32),
+                          rng.randint(1, VOCAB + 1, (8,)).astype(np.float32))
+                   for _ in range(8)]
+        m = transformer.build_lm(VOCAB, 16, 2, 32, num_layers=1, max_len=16,
+                                 rope=True, fused_head=True)
+        opt = Optimizer(m, DataSet.array(samples).transform(
+            SampleToBatch(batch_size=4)), nn.FusedLMHeadCriterion(chunk=32))
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_iteration(3))
+        opt.optimize()
+
+    def test_rope_guards(self):
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        with pytest.raises(ValueError, match="even head_dim"):
+            MultiHeadAttention(6, 2, rope=True)  # head_dim 3
+        with pytest.raises(ValueError, match="context-parallel"):
+            MultiHeadAttention(16, 2, rope=True, seq_axis="seq")
+
+    def test_rope_cross_attention_rejected(self):
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        from bigdl_tpu.utils.table import Table
+        m = MultiHeadAttention(16, 2, rope=True).evaluate_mode()
+        q = jnp.ones((1, 4, 16))
+        kv = jnp.ones((1, 7, 16))
+        with pytest.raises(ValueError, match="self-attention only"):
+            m.forward(Table(q, kv, kv))
+
+    def test_rope_dropout_kept(self):
+        m = transformer.build_lm(VOCAB, 16, 2, 32, num_layers=1, max_len=16,
+                                 rope=True, dropout=0.1)
+        names = [type(c).__name__ for c in m._modules.values()]
+        assert "Dropout" in names  # embedding-stream dropout preserved
