@@ -84,18 +84,23 @@ def _named_params(model: Module) -> List[Tuple[str, Module, str]]:
         if attn.with_bias:
             out.append((f"{p}.self_attn.out_proj.bias", attn,
                         "out_proj_bias"))
-        for lin_name in ("linear1", "linear2"):
+        lin_names = ["linear1", "linear2"]
+        if "linear_gate" in layer._modules:  # swiglu gate (our naming —
+            lin_names.append("linear_gate")  # no torch-module analogue)
+        for lin_name in lin_names:
             lin = layer._modules[lin_name]
             out.append((f"{p}.{lin_name}.weight", lin, "weight"))
             if lin.with_bias:
                 out.append((f"{p}.{lin_name}.bias", lin, "bias"))
         for norm_name in ("norm1", "norm2"):
-            ln: LayerNorm = layer._modules[norm_name]
+            ln = layer._modules[norm_name]
             out.append((f"{p}.{norm_name}.weight", ln, "weight"))
-            out.append((f"{p}.{norm_name}.bias", ln, "bias"))
+            if "bias" in ln._parameters:  # RMSNorm has gain only
+                out.append((f"{p}.{norm_name}.bias", ln, "bias"))
     if enc.final_norm is not None:
         out.append(("encoder.norm.weight", enc.final_norm, "weight"))
-        out.append(("encoder.norm.bias", enc.final_norm, "bias"))
+        if "bias" in enc.final_norm._parameters:
+            out.append(("encoder.norm.bias", enc.final_norm, "bias"))
     if isinstance(head, TiedLMHead):
         # GPT-2 convention: tied checkpoints carry NO lm_head.* keys — the
         # head IS embedding.weight (already emitted above)

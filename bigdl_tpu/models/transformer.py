@@ -24,7 +24,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
              moe_experts: int = 0, moe_k: int = 2,
              fused_head: bool = False,
              tie_embeddings: bool = False,
-             rope: bool = False) -> nn.Sequential:
+             rope: bool = False, activation: str = "gelu",
+             norm: str = "layer") -> nn.Sequential:
     """Causal LM: 1-based token ids (N, T) -> log-probs (N, T, vocab).
 
     ``seq_axis="seq"`` shards every attention layer over the mesh sequence
@@ -49,7 +50,12 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
     ``rope=True`` replaces the additive sinusoidal PositionalEncoding with
     rotary embeddings on q/k (relative positions; the modern standard) —
     the PE module is dropped entirely. Not yet composable with
-    ``seq_axis`` context parallelism."""
+    ``seq_axis`` context parallelism.
+
+    ``activation="swiglu"`` + ``norm="rms"`` + ``rope=True`` +
+    ``tie_embeddings=True`` is the Llama-family block recipe — every
+    piece composes with the fused-CE tail, KV-cached generation, and
+    int8 quantization."""
     embed = nn.LookupTable(vocab_size, embed_dim)
     m = nn.Sequential().add(embed)
     if not rope:
@@ -59,6 +65,7 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
         m.add(nn.Dropout(dropout))
     m.add(nn.TransformerEncoder(num_layers, embed_dim, num_heads,
                                 ffn_dim, dropout=dropout, causal=True,
+                                activation=activation, norm=norm,
                                 seq_axis=seq_axis, seq_mode=seq_mode,
                                 seq_layout=seq_layout,
                                 moe_experts=moe_experts,

@@ -72,6 +72,6 @@ from bigdl_tpu.nn.recurrent import (
     BiRecurrent, TimeDistributed,
 )
 from bigdl_tpu.nn.attention import (
-    LayerNorm, MultiHeadAttention, PositionalEncoding,
+    LayerNorm, RMSNorm, MultiHeadAttention, PositionalEncoding,
     TransformerEncoderLayer, TransformerEncoder,
 )

@@ -62,6 +62,26 @@ class LayerNorm(TensorModule):
         return f"LayerNorm({self.normalized_shape})"
 
 
+class RMSNorm(TensorModule):
+    """Root-mean-square normalisation (Zhang & Sennrich) — the Llama-family
+    replacement for LayerNorm: no mean subtraction, no bias, one gain.
+    fp32 statistics like LayerNorm."""
+
+    def __init__(self, dim: int, eps: float = 1e-6):
+        super().__init__()
+        self.dim, self.eps = dim, eps
+        self.register_parameter("weight", init.ones((dim,)))
+
+    def update_output(self, input):
+        x = input.astype(jnp.float32)
+        y = x * jax.lax.rsqrt(jnp.mean(jnp.square(x), axis=-1,
+                                       keepdims=True) + self.eps)
+        return y.astype(input.dtype) * self.weight
+
+    def __repr__(self):
+        return f"RMSNorm({self.dim})"
+
+
 class MultiHeadAttention(Module):
     """Multi-head attention with fused qkv projection.
 
@@ -328,7 +348,8 @@ class TransformerEncoderLayer(Module):
                  pre_norm: bool = True, causal: bool = False,
                  block_size: int = 0, seq_axis: Optional[str] = None,
                  seq_mode: str = "ring", seq_layout: str = "contiguous",
-                 moe_experts: int = 0, moe_k: int = 2, rope: bool = False):
+                 moe_experts: int = 0, moe_k: int = 2, rope: bool = False,
+                 norm: str = "layer"):
         super().__init__()
         from bigdl_tpu.nn.linear import Linear
         from bigdl_tpu.nn.regularization import Dropout
@@ -344,6 +365,8 @@ class TransformerEncoderLayer(Module):
                                             seq_layout=seq_layout,
                                             rope=rope)
         if moe_experts:
+            if activation == "swiglu":
+                raise ValueError("swiglu FFN does not compose with MoE yet")
             # MoE FFN: top-k routed expert MLPs replace the dense pair;
             # under expert parallelism the stacked expert leaves shard
             # over the mesh 'expert' axis (parallel/expert.py)
@@ -353,8 +376,18 @@ class TransformerEncoderLayer(Module):
         else:
             self.linear1 = Linear(embed_dim, ffn_dim)
             self.linear2 = Linear(ffn_dim, embed_dim)
-        self.norm1 = LayerNorm(embed_dim)
-        self.norm2 = LayerNorm(embed_dim)
+            if activation == "swiglu":
+                # Llama-style gated FFN: W2(silu(W1 x) * Wg x); the gate is
+                # a third column-parallel projection
+                self.linear_gate = Linear(embed_dim, ffn_dim)
+        if norm == "layer":
+            self.norm1 = LayerNorm(embed_dim)
+            self.norm2 = LayerNorm(embed_dim)
+        elif norm == "rms":
+            self.norm1 = RMSNorm(embed_dim)
+            self.norm2 = RMSNorm(embed_dim)
+        else:
+            raise ValueError(f"unknown norm {norm!r}: 'layer' or 'rms'")
 
     def _act(self, x):
         if self.activation == "gelu":
@@ -369,6 +402,10 @@ class TransformerEncoderLayer(Module):
     def _ffn(self, x):
         if self.moe_experts:
             return self.moe.forward(x)
+        if self.activation == "swiglu":
+            up = self.linear1.forward(x)
+            gate = self.linear_gate.forward(x)
+            return self.linear2.forward(jax.nn.silu(up) * gate)
         return self.linear2.forward(self._act(self.linear1.forward(x)))
 
     def update_output(self, input):
@@ -401,7 +438,8 @@ class TransformerEncoder(Module):
                  pre_norm: bool = True, causal: bool = False,
                  block_size: int = 0, seq_axis: Optional[str] = None,
                  seq_mode: str = "ring", seq_layout: str = "contiguous",
-                 moe_experts: int = 0, moe_k: int = 2, rope: bool = False):
+                 moe_experts: int = 0, moe_k: int = 2, rope: bool = False,
+                 norm: str = "layer"):
         super().__init__()
         self.num_layers = num_layers
         for i in range(num_layers):
@@ -410,8 +448,13 @@ class TransformerEncoder(Module):
                 activation=activation, pre_norm=pre_norm, causal=causal,
                 block_size=block_size, seq_axis=seq_axis, seq_mode=seq_mode,
                 seq_layout=seq_layout, moe_experts=moe_experts, moe_k=moe_k,
-                rope=rope))
-        self.final_norm = LayerNorm(embed_dim) if pre_norm else None
+                rope=rope, norm=norm))
+        if not pre_norm:
+            self.final_norm = None
+        elif norm == "rms":
+            self.final_norm = RMSNorm(embed_dim)
+        else:
+            self.final_norm = LayerNorm(embed_dim)
         if self.final_norm is not None:
             self.add_module("final_norm", self.final_norm)
 

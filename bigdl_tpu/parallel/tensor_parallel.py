@@ -131,6 +131,9 @@ def _tag_children(module) -> None:
             module.linear1.tp_mode = COLUMN
         if not hasattr(module.linear2, "tp_mode"):
             module.linear2.tp_mode = ROW
+        gate = module._modules.get("linear_gate")
+        if gate is not None and not hasattr(gate, "tp_mode"):
+            gate.tp_mode = COLUMN  # swiglu gate: second column projection
         return
     if isinstance(module, nn.TimeDistributed):
         inner = getattr(module, "inner", None) or \

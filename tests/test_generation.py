@@ -505,3 +505,63 @@ class TestRope:
                                  rope=True, dropout=0.1)
         names = [type(c).__name__ for c in m._modules.values()]
         assert "Dropout" in names  # embedding-stream dropout preserved
+
+
+class TestLlamaRecipe:
+    def test_rmsnorm_math(self):
+        m = nn.RMSNorm(8)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 8)
+                        .astype(np.float32))
+        out = np.asarray(m.forward(x))
+        want = np.asarray(x) / np.sqrt(
+            (np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+        assert len(m.parameters()) == 1  # gain only, no bias
+
+    def test_swiglu_ffn_structure(self):
+        from bigdl_tpu.nn.attention import TransformerEncoderLayer
+        layer = TransformerEncoderLayer(16, 2, 32, activation="swiglu",
+                                        norm="rms")
+        names = set(layer._modules)
+        assert {"linear1", "linear2", "linear_gate"} <= names
+        assert type(layer.norm1).__name__ == "RMSNorm"
+        out = layer.evaluate_mode().forward(jnp.ones((1, 4, 16)))
+        assert out.shape == (1, 4, 16)
+
+    def test_llama_recipe_trains_and_generates(self):
+        from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+        from bigdl_tpu.optim import AdamW, Optimizer, Trigger
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randint(1, VOCAB + 1, (8,)).astype(np.float32),
+                          rng.randint(1, VOCAB + 1, (8,)).astype(np.float32))
+                   for _ in range(8)]
+        m = transformer.build_lm(VOCAB, 16, 2, 32, num_layers=2, max_len=32,
+                                 rope=True, activation="swiglu", norm="rms",
+                                 tie_embeddings=True)
+        opt = Optimizer(m, DataSet.array(samples).transform(
+            SampleToBatch(batch_size=4)), nn.FusedLMHeadCriterion(chunk=32))
+        opt.set_optim_method(AdamW(learningrate=1e-3))
+        opt.set_end_when(Trigger.max_iteration(3))
+        trained = opt.optimize()
+        # cached greedy decode matches full forward on the llama block
+        p = jnp.array([[3.0, 9.0]])
+        want = greedy_no_cache(trained.evaluate_mode(), p, 6)
+        got = generate(trained, p, 6, greedy=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_swiglu_moe_rejected(self):
+        from bigdl_tpu.nn.attention import TransformerEncoderLayer
+        with pytest.raises(ValueError, match="swiglu"):
+            TransformerEncoderLayer(16, 2, 32, activation="swiglu",
+                                    moe_experts=2)
+
+    def test_swiglu_tp_tagging(self):
+        from bigdl_tpu.nn.attention import TransformerEncoderLayer
+        from bigdl_tpu.parallel.tensor_parallel import infer_param_specs
+        from jax.sharding import PartitionSpec as P
+        layer = TransformerEncoderLayer(16, 2, 32, activation="swiglu")
+        m = nn.Sequential().add(layer)
+        gate_spec = infer_param_specs(m)
+        l = gate_spec[list(gate_spec)[0]]
+        assert l["linear_gate"]["weight"] == P("tensor", None)  # column
+        assert l["linear2"]["weight"] == P(None, "tensor")      # row

@@ -146,3 +146,19 @@ class TestTiedModels:
         from bigdl_tpu import nn
         with pytest.raises(ValueError, match="max-norm"):
             nn.TiedLMHead(nn.LookupTable(10, 4, max_norm=1.0))
+
+
+class TestLlamaRecipeInterop:
+    def test_rms_swiglu_roundtrip(self):
+        kw = dict(num_layers=1, max_len=16, rope=True,
+                  activation="swiglu", norm="rms")
+        src = transformer.build_lm(V, E, 2, F, **kw)
+        dst = transformer.build_lm(V, E, 2, F, **kw)
+        sd = export_lm_state_dict(src)
+        assert "encoder.layers.0.linear_gate.weight" in sd
+        assert "encoder.layers.0.norm1.bias" not in sd  # RMSNorm: gain only
+        import_lm_state_dict(dst, sd)
+        x = jnp.asarray([[3.0, 5.0]])
+        np.testing.assert_allclose(
+            np.asarray(dst.evaluate_mode().predict(x)),
+            np.asarray(src.evaluate_mode().predict(x)), atol=1e-6)
