@@ -6,16 +6,19 @@ transformers; a migrating LM user's checkpoint today is a torch
 the standard torch naming so weights move in either direction:
 
     embedding.weight                                 LookupTable (V, E)
-    encoder.layers.{i}.self_attn.in_proj_weight      (3E, E)  q;k;v stacked
-    encoder.layers.{i}.self_attn.in_proj_bias        (3E,)
+    encoder.layers.{i}.self_attn.in_proj_weight      (3E, E) q;k;v stacked
+                                                     (GQA: (E + 2*E_kv, E))
+    encoder.layers.{i}.self_attn.in_proj_bias        matches in_proj rows
     encoder.layers.{i}.self_attn.out_proj.weight     (E, E)
     encoder.layers.{i}.self_attn.out_proj.bias       (E,)
     encoder.layers.{i}.linear1.{weight,bias}         FFN up
+    encoder.layers.{i}.linear_gate.{weight,bias}     swiglu gate (if present)
     encoder.layers.{i}.linear2.{weight,bias}         FFN down
-    encoder.layers.{i}.norm1.{weight,bias}
-    encoder.layers.{i}.norm2.{weight,bias}
-    encoder.norm.{weight,bias}                       final pre-norm LN
-    lm_head.{weight,bias}                            (V, E) vocab projection
+    encoder.layers.{i}.norm1.{weight[,bias]}         bias only for LayerNorm
+    encoder.layers.{i}.norm2.{weight[,bias]}         (RMSNorm: gain only)
+    encoder.norm.{weight[,bias]}                     final pre-norm norm
+    lm_head.{weight,bias}                            (V, E); ABSENT when
+                                                     tie_embeddings
 
 Layouts already match torch's (``nn.MultiheadAttention`` in_proj stacking,
 ``Linear`` (out, in)) — the module zoo keeps torch conventions precisely so
@@ -38,8 +41,7 @@ from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
-from bigdl_tpu.nn.attention import (LayerNorm, MultiHeadAttention,
-                                    TransformerEncoder)
+from bigdl_tpu.nn.attention import MultiHeadAttention, TransformerEncoder
 from bigdl_tpu.nn.linear import (LMHead, Linear, LookupTable,
                                  TiedLMHead)
 from bigdl_tpu.nn.module import Module

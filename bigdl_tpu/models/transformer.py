@@ -25,7 +25,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
              fused_head: bool = False,
              tie_embeddings: bool = False,
              rope: bool = False, activation: str = "gelu",
-             norm: str = "layer") -> nn.Sequential:
+             norm: str = "layer",
+             num_kv_heads: Optional[int] = None) -> nn.Sequential:
     """Causal LM: 1-based token ids (N, T) -> log-probs (N, T, vocab).
 
     ``seq_axis="seq"`` shards every attention layer over the mesh sequence
@@ -69,7 +70,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
                                 seq_axis=seq_axis, seq_mode=seq_mode,
                                 seq_layout=seq_layout,
                                 moe_experts=moe_experts,
-                                moe_k=moe_k, rope=rope))
+                                moe_k=moe_k, rope=rope,
+                                num_kv_heads=num_kv_heads))
     if tie_embeddings:
         return m.add(nn.TiedLMHead(embed))
     if fused_head:

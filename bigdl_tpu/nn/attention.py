@@ -107,9 +107,19 @@ class MultiHeadAttention(Module):
                  dropout: float = 0.0, with_bias: bool = True,
                  causal: bool = False, block_size: int = 0,
                  seq_axis: Optional[str] = None, seq_mode: str = "ring",
-                 seq_layout: str = "contiguous", rope: bool = False):
+                 seq_layout: str = "contiguous", rope: bool = False,
+                 num_kv_heads: Optional[int] = None):
         super().__init__()
         assert embed_dim % num_heads == 0, "embed_dim must divide num_heads"
+        # GQA (grouped-query attention): num_kv_heads < num_heads shares
+        # each k/v head across num_heads // num_kv_heads query heads — the
+        # KV cache (decode's memory hog) shrinks by that factor. The
+        # in_proj weight is then (E + 2*E_kv, E) instead of torch's 3E
+        # stacking, so torch-layout interchange only holds for full MHA.
+        self.num_kv_heads = num_kv_heads or num_heads
+        if num_heads % self.num_kv_heads != 0:
+            raise ValueError(f"num_kv_heads {self.num_kv_heads} must divide "
+                             f"num_heads {num_heads}")
         # rope: rotary position embeddings applied to q/k per head (the
         # model then needs NO additive PositionalEncoding). Rotation uses
         # absolute positions (decode_pos-offset while decoding), so cached
@@ -139,14 +149,17 @@ class MultiHeadAttention(Module):
         self.dropout = Dropout(dropout)
         # 0 = plain XLA attention; >0 = blockwise (flash) with that block.
         self.block_size = block_size
+        e_kv = self.num_kv_heads * self.head_dim
+        self._e_kv = e_kv
         self.register_parameter(
-            "in_proj_weight", init.xavier((3 * embed_dim, embed_dim),
+            "in_proj_weight", init.xavier((embed_dim + 2 * e_kv, embed_dim),
                                           embed_dim, embed_dim))
         self.register_parameter(
             "out_proj_weight", init.xavier((embed_dim, embed_dim),
                                            embed_dim, embed_dim))
         if with_bias:
-            self.register_parameter("in_proj_bias", init.zeros((3 * embed_dim,)))
+            self.register_parameter("in_proj_bias",
+                                    init.zeros((embed_dim + 2 * e_kv,)))
             self.register_parameter("out_proj_bias", init.zeros((embed_dim,)))
         self.attn_mask: Optional[jax.Array] = None
 
@@ -164,7 +177,9 @@ class MultiHeadAttention(Module):
             raise ValueError("decode mode is incompatible with "
                              "context-parallel attention (seq_axis)")
         dt = self.in_proj_weight.dtype
-        shape = (batch_size, max_len, self.num_heads, self.head_dim)
+        shape = (batch_size, max_len,
+                 getattr(self, "num_kv_heads", self.num_heads),
+                 self.head_dim)
         self._decode = True
         self._decode_prefilled = False
         self.register_buffer("k_cache", jnp.zeros(shape, dt))
@@ -203,11 +218,30 @@ class MultiHeadAttention(Module):
                     "forward in decode mode would ignore the cached context "
                     "(re-enable_decode and prefill the full prompt at once)")
             self._decode_prefilled = True
-            return self._attend(q, k, v, None)
+            return self._attend(q, self._expand_kv(k), self._expand_kv(v),
+                                None)
         k_pos = jnp.arange(self.k_cache.shape[1])[None, :]
         q_pos = pos + jnp.arange(s)[:, None]
-        return attention_core.dot_product_attention(
-            q, self.k_cache, self.v_cache, mask=k_pos <= q_pos, causal=False)
+        n_kv = self.k_cache.shape[2]
+        if n_kv == self.num_heads:
+            return attention_core.dot_product_attention(
+                q, self.k_cache, self.v_cache,
+                mask=k_pos <= q_pos, causal=False)
+        # GQA steady state: grouped einsum reads the cache at its SMALL
+        # size (an expand-then-attend would copy the whole cache to full
+        # head count every step, forfeiting the bandwidth win)
+        b, _, h, d = q.shape
+        g = h // n_kv
+        q_vec = q.reshape(b, n_kv, g, d)           # s == 1
+        logits = jnp.einsum("bkgd,blkd->bkgl", q_vec, self.k_cache)
+        logits = (logits * (1.0 / float(d) ** 0.5)).astype(jnp.float32)
+        valid = (k_pos[0] <= q_pos[0, 0])  # (L,)
+        logits = jnp.where(valid[None, None, None, :], logits,
+                           jnp.finfo(jnp.float32).min)
+        w = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("bkgl,blkd->bkgd", w.astype(self.v_cache.dtype),
+                         self.v_cache)
+        return ctx.reshape(b, 1, h, d)
 
     def set_mask(self, mask: Optional[jax.Array]) -> "MultiHeadAttention":
         """Static structural mask (baked in at trace time — see class doc;
@@ -216,8 +250,16 @@ class MultiHeadAttention(Module):
         return self
 
     def _split_heads(self, x):
-        b, s, _ = x.shape
-        return x.reshape(b, s, self.num_heads, self.head_dim)
+        b, s, e = x.shape
+        return x.reshape(b, s, e // self.head_dim, self.head_dim)
+
+    def _expand_kv(self, kv):
+        """Repeat kv heads up to num_heads for the attention cores (GQA);
+        identity for full MHA."""
+        n_kv = kv.shape[2]
+        if n_kv == self.num_heads:
+            return kv
+        return jnp.repeat(kv, self.num_heads // n_kv, axis=2)
 
     def _project(self, x, w, b):
         y = jnp.matmul(match_compute(x, w), w.T)
@@ -238,11 +280,14 @@ class MultiHeadAttention(Module):
             query = key = value = input
 
         e = self.embed_dim
-        wq, wk, wv = (self.in_proj_weight[:e], self.in_proj_weight[e:2 * e],
-                      self.in_proj_weight[2 * e:])
+        ekv = getattr(self, "_e_kv", e)
+        wq, wk, wv = (self.in_proj_weight[:e],
+                      self.in_proj_weight[e:e + ekv],
+                      self.in_proj_weight[e + ekv:])
         if self.with_bias:
-            bq, bk, bv = (self.in_proj_bias[:e], self.in_proj_bias[e:2 * e],
-                          self.in_proj_bias[2 * e:])
+            bq, bk, bv = (self.in_proj_bias[:e],
+                          self.in_proj_bias[e:e + ekv],
+                          self.in_proj_bias[e + ekv:])
         else:
             bq = bk = bv = None
         q = self._split_heads(self._project(query, wq, bq))
@@ -264,7 +309,8 @@ class MultiHeadAttention(Module):
         if self._decode:
             ctx = self._attend_decode(q, k, v)
         else:
-            ctx = self._attend(q, k, v, mask)
+            ctx = self._attend(q, self._expand_kv(k), self._expand_kv(v),
+                               mask)
 
         b, s, _, _ = ctx.shape
         ctx = ctx.reshape(b, s, e)
@@ -349,7 +395,7 @@ class TransformerEncoderLayer(Module):
                  block_size: int = 0, seq_axis: Optional[str] = None,
                  seq_mode: str = "ring", seq_layout: str = "contiguous",
                  moe_experts: int = 0, moe_k: int = 2, rope: bool = False,
-                 norm: str = "layer"):
+                 norm: str = "layer", num_kv_heads: Optional[int] = None):
         super().__init__()
         from bigdl_tpu.nn.linear import Linear
         from bigdl_tpu.nn.regularization import Dropout
@@ -363,7 +409,8 @@ class TransformerEncoderLayer(Module):
                                             seq_axis=seq_axis,
                                             seq_mode=seq_mode,
                                             seq_layout=seq_layout,
-                                            rope=rope)
+                                            rope=rope,
+                                            num_kv_heads=num_kv_heads)
         if moe_experts:
             if activation == "swiglu":
                 raise ValueError("swiglu FFN does not compose with MoE yet")
@@ -439,7 +486,7 @@ class TransformerEncoder(Module):
                  block_size: int = 0, seq_axis: Optional[str] = None,
                  seq_mode: str = "ring", seq_layout: str = "contiguous",
                  moe_experts: int = 0, moe_k: int = 2, rope: bool = False,
-                 norm: str = "layer"):
+                 norm: str = "layer", num_kv_heads: Optional[int] = None):
         super().__init__()
         self.num_layers = num_layers
         for i in range(num_layers):
@@ -448,7 +495,7 @@ class TransformerEncoder(Module):
                 activation=activation, pre_norm=pre_norm, causal=causal,
                 block_size=block_size, seq_axis=seq_axis, seq_mode=seq_mode,
                 seq_layout=seq_layout, moe_experts=moe_experts, moe_k=moe_k,
-                rope=rope, norm=norm))
+                rope=rope, norm=norm, num_kv_heads=num_kv_heads))
         if not pre_norm:
             self.final_norm = None
         elif norm == "rms":

@@ -565,3 +565,51 @@ class TestLlamaRecipe:
         l = gate_spec[list(gate_spec)[0]]
         assert l["linear_gate"]["weight"] == P("tensor", None)  # column
         assert l["linear2"]["weight"] == P(None, "tensor")      # row
+
+
+class TestGQA:
+    def test_kv_cache_shrinks(self):
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        m = MultiHeadAttention(32, 8, num_kv_heads=2, causal=True)
+        m.enable_decode(1, 16)
+        assert m._buffers["k_cache"].shape == (1, 16, 2, 4)  # H_kv=2
+        m.disable_decode()
+        full = MultiHeadAttention(32, 8, causal=True)
+        full.enable_decode(1, 16)
+        assert full._buffers["k_cache"].shape == (1, 16, 8, 4)
+
+    def test_gqa_decode_parity(self):
+        model = transformer.build_lm(VOCAB, 32, 8, 64, num_layers=2,
+                                     max_len=64, rope=True, num_kv_heads=2)
+        p = jnp.array([[3.0, 9.0, 4.0]])
+        want = greedy_no_cache(model, p, 10)
+        got = generate(model, p, 10, greedy=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_gqa_kv_equals_mha_when_full(self):
+        """num_kv_heads=num_heads is exactly standard MHA (same param
+        shapes, same torch layout)."""
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        a = MultiHeadAttention(16, 4)
+        b = MultiHeadAttention(16, 4, num_kv_heads=4)
+        assert a.in_proj_weight.shape == b.in_proj_weight.shape == (48, 16)
+
+    def test_bad_kv_heads_rejected(self):
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        with pytest.raises(ValueError, match="divide"):
+            MultiHeadAttention(32, 8, num_kv_heads=3)
+
+    def test_gqa_trains(self):
+        from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
+        from bigdl_tpu.optim import SGD, Optimizer, Trigger
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randint(1, VOCAB + 1, (8,)).astype(np.float32),
+                          rng.randint(1, VOCAB + 1, (8,)).astype(np.float32))
+                   for _ in range(8)]
+        m = transformer.build_lm(VOCAB, 32, 4, 64, num_layers=1, max_len=16,
+                                 num_kv_heads=2, fused_head=True)
+        opt = Optimizer(m, DataSet.array(samples).transform(
+            SampleToBatch(batch_size=4)), nn.FusedLMHeadCriterion(chunk=32))
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_iteration(3))
+        opt.optimize()
