@@ -613,3 +613,15 @@ class TestGQA:
         opt.set_optim_method(SGD(learningrate=0.1))
         opt.set_end_when(Trigger.max_iteration(3))
         opt.optimize()
+
+    def test_gqa_dp_mesh_decode(self):
+        import jax
+        from jax.sharding import Mesh
+        model = transformer.build_lm(VOCAB, 32, 8, 64, num_layers=1,
+                                     max_len=32, rope=True, num_kv_heads=2)
+        p = jnp.asarray(np.random.RandomState(2)
+                        .randint(1, VOCAB + 1, (8, 4)).astype(np.float32))
+        want = generate(model, p, 5, greedy=True)
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        got = generate(model, p, 5, greedy=True, mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

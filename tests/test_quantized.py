@@ -137,3 +137,18 @@ class TestQuantizedModel:
         lp = np.asarray(model.evaluate_mode().predict(jnp.ones((1, 3))))
         qlp = np.asarray(qmodel.predict(jnp.ones((1, 3))), np.float32)
         assert np.abs(lp - qlp).max() < 0.5
+
+    def test_gqa_llama_block_quantizes(self):
+        """int8 + GQA + RoPE + RMSNorm + SwiGLU: the full modern serving
+        stack composes (small cache AND 1-byte weights)."""
+        model = transformer.build_lm(60, 32, 8, 64, num_layers=1,
+                                     max_len=32, rope=True, num_kv_heads=2,
+                                     norm="rms", activation="swiglu")
+        qmodel = quantize_model(model)
+        assert qmodel.parameters() == []
+        out = generate(qmodel, jnp.ones((2, 3)), 5, greedy=True)
+        assert np.asarray(out).shape == (2, 8)
+        # int8 tracks fp32 on this stack too
+        lp = np.asarray(model.evaluate_mode().predict(jnp.ones((1, 4))))
+        qlp = np.asarray(qmodel.predict(jnp.ones((1, 4))), np.float32)
+        assert np.abs(lp - qlp).max() < 0.5
