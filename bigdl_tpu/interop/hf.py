@@ -1,0 +1,268 @@
+"""HuggingFace-layout checkpoint import for GPT-2- and Llama-family LMs.
+
+The reference's defining interop move is loading a FOREIGN framework's
+pretrained weights into its own modules by structural mapping
+(``utils/CaffeLoader.scala:132`` ``copyParameters`` name-matches caffemodel
+blobs; ``utils/TorchFile.scala:67`` maps ~30 Lua ``nn.*`` classes). This
+module replays that move for the LM era: the checkpoints a migrating user
+actually holds today are HF ``transformers`` state_dicts, and the two
+layouts that cover most of them are GPT-2's (fused Conv1D ``c_attn``,
+learned ``wpe`` positions, tied head) and Llama's (split q/k/v with GQA,
+RoPE, RMSNorm, gated SwiGLU MLP, no biases).
+
+Both importers are NAME + LAYOUT maps onto ``models.transformer.build_lm``:
+
+GPT-2 (``GPT2LMHeadModel``): HF stores every projection as ``Conv1D`` —
+weight (in, out), the TRANSPOSE of torch/our Linear (out, in) — so each
+``c_attn``/``c_proj``/``c_fc`` weight transposes on the way in; the fused
+``c_attn`` columns are already q;k;v-stacked, which after transposition is
+exactly our ``in_proj_weight`` row stacking.
+
+Llama (``LlamaForCausalLM``): separate ``q_proj``/``k_proj``/``v_proj``
+Linears concatenate row-wise into our GQA ``in_proj_weight``
+((E + 2*E_kv, E) — the k/v blocks are the GROUPED size, so grouped-query
+checkpoints load without expansion); ``gate_proj`` (inside silu) is our
+``linear1``, ``up_proj`` our ``linear_gate``, ``down_proj`` our
+``linear2``; RoPE pairing is the same rotate-half convention, so q/k need
+no permutation (``nn/attention.py:rope_rotate``).
+
+Token ids stay 1-based on our side: the tables are copied verbatim, so our
+id ``k`` denotes the same token as HF id ``k-1`` (shift ids by +1 on the
+way in, -1 on the way out — ``to_framework_ids``/``to_hf_ids``).
+
+Model output is LOG-probabilities (the framework's LM tail convention),
+= ``log_softmax`` of HF logits; perplexity and greedy/beam sampling are
+therefore directly comparable (verified to 1e-4 by
+``tests/test_hf_interop.py`` against live ``transformers`` torch models).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.interop.state_dict import import_lm_state_dict
+from bigdl_tpu.nn.module import Module
+
+
+def to_framework_ids(ids):
+    """HF 0-based token ids -> this framework's 1-based ids."""
+    return np.asarray(ids) + 1
+
+
+def to_hf_ids(ids):
+    """This framework's 1-based token ids -> HF 0-based ids."""
+    return np.asarray(ids) - 1
+
+
+def _np(v) -> np.ndarray:
+    """Materialise a state_dict value (torch tensor / jax / numpy) as fp32
+    numpy without importing torch here."""
+    if hasattr(v, "detach"):  # torch.Tensor
+        v = v.detach().cpu()
+        if hasattr(v, "float"):
+            v = v.float()
+        v = v.numpy()
+    return np.asarray(v, np.float32)
+
+
+# --------------------------------------------------------------------- GPT-2
+
+def gpt2_lm_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
+    """``build_lm`` kwargs for an HF GPT-2 ``config.json`` dict."""
+    e = int(config["n_embd"])
+    n_inner = config.get("n_inner") or 4 * e
+    act = config.get("activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh", "gelu"):
+        raise ValueError(f"unsupported GPT-2 activation {act!r}")
+    # "gelu" (exact erf) differs from our tanh-approx at ~1e-3; GPT-2
+    # proper is gelu_new, so accept and document rather than refuse
+    return dict(
+        vocab_size=int(config["vocab_size"]),
+        embed_dim=e,
+        num_heads=int(config["n_head"]),
+        ffn_dim=int(n_inner),
+        num_layers=int(config["n_layer"]),
+        max_len=int(config.get("n_positions", 1024)),
+        pos="learned",
+        tie_embeddings=True,
+        activation="gelu",
+        norm="layer",
+        norm_eps=float(config.get("layer_norm_epsilon", 1e-5)),
+    )
+
+
+def gpt2_state_dict_to_lm(hf_sd: Dict[str, Any],
+                          num_layers: int) -> Dict[str, np.ndarray]:
+    """HF GPT-2 state_dict -> our torch-convention LM state_dict.
+
+    Accepts ``GPT2LMHeadModel`` keys (``transformer.``-prefixed) or bare
+    ``GPT2Model`` keys. Ignores the non-weight buffers HF carries
+    (``attn.bias`` causal mask, ``attn.masked_bias``) and the tied
+    ``lm_head.weight`` duplicate.
+    """
+    sd = {}
+    for k, v in hf_sd.items():
+        if k.startswith("transformer."):
+            k = k[len("transformer."):]
+        sd[k] = v
+    out: Dict[str, np.ndarray] = {
+        "embedding.weight": _np(sd["wte.weight"]),
+        "pos_embedding.weight": _np(sd["wpe.weight"]),
+        "encoder.norm.weight": _np(sd["ln_f.weight"]),
+        "encoder.norm.bias": _np(sd["ln_f.bias"]),
+    }
+    for i in range(num_layers):
+        src, dst = f"h.{i}", f"encoder.layers.{i}"
+        out[f"{dst}.norm1.weight"] = _np(sd[f"{src}.ln_1.weight"])
+        out[f"{dst}.norm1.bias"] = _np(sd[f"{src}.ln_1.bias"])
+        out[f"{dst}.norm2.weight"] = _np(sd[f"{src}.ln_2.weight"])
+        out[f"{dst}.norm2.bias"] = _np(sd[f"{src}.ln_2.bias"])
+        # Conv1D (in, out) -> Linear (out, in): transpose
+        out[f"{dst}.self_attn.in_proj_weight"] = \
+            _np(sd[f"{src}.attn.c_attn.weight"]).T.copy()
+        out[f"{dst}.self_attn.in_proj_bias"] = \
+            _np(sd[f"{src}.attn.c_attn.bias"])
+        out[f"{dst}.self_attn.out_proj.weight"] = \
+            _np(sd[f"{src}.attn.c_proj.weight"]).T.copy()
+        out[f"{dst}.self_attn.out_proj.bias"] = \
+            _np(sd[f"{src}.attn.c_proj.bias"])
+        out[f"{dst}.linear1.weight"] = _np(sd[f"{src}.mlp.c_fc.weight"]).T.copy()
+        out[f"{dst}.linear1.bias"] = _np(sd[f"{src}.mlp.c_fc.bias"])
+        out[f"{dst}.linear2.weight"] = _np(sd[f"{src}.mlp.c_proj.weight"]).T.copy()
+        out[f"{dst}.linear2.bias"] = _np(sd[f"{src}.mlp.c_proj.bias"])
+    return out
+
+
+def load_gpt2(config: Dict[str, Any], state_dict: Dict[str, Any]) -> Module:
+    """Build a ``build_lm`` model from an HF GPT-2 config + state_dict."""
+    from bigdl_tpu.models.transformer import build_lm
+    kwargs = gpt2_lm_kwargs(config)
+    model = build_lm(**kwargs)
+    ours = gpt2_state_dict_to_lm(state_dict, kwargs["num_layers"])
+    return import_lm_state_dict(model, ours, strict=True)
+
+
+# --------------------------------------------------------------------- Llama
+
+def llama_lm_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
+    """``build_lm`` kwargs for an HF Llama-family ``config.json`` dict."""
+    if config.get("attention_bias", False) or config.get("mlp_bias", False):
+        raise ValueError("biased Llama variants are not mapped (set "
+                         "attention_bias/mlp_bias False)")
+    act = config.get("hidden_act", "silu")
+    if act != "silu":
+        raise ValueError(f"unsupported Llama activation {act!r}")
+    scaling = config.get("rope_scaling")
+    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+        # Llama-3.1+ NTK/llama3 frequency scaling would silently change
+        # every attention score if ignored — refuse, don't corrupt
+        raise ValueError(f"rope_scaling {scaling!r} is not supported yet "
+                         "(plain rope_theta frequencies only)")
+    if config.get("sliding_window"):
+        raise ValueError("sliding-window attention (Mistral v0.1-style) is "
+                         "not mapped: imported models attend globally and "
+                         "would diverge beyond the window")
+    heads = int(config["num_attention_heads"])
+    return dict(
+        vocab_size=int(config["vocab_size"]),
+        embed_dim=int(config["hidden_size"]),
+        num_heads=heads,
+        num_kv_heads=int(config.get("num_key_value_heads", heads)),
+        ffn_dim=int(config["intermediate_size"]),
+        num_layers=int(config["num_hidden_layers"]),
+        max_len=int(config.get("max_position_embeddings", 2048)),
+        rope=True,
+        rope_theta=float(config.get("rope_theta", 10000.0)),
+        activation="swiglu",
+        norm="rms",
+        norm_eps=float(config.get("rms_norm_eps", 1e-6)),
+        bias=False,
+        tie_embeddings=bool(config.get("tie_word_embeddings", False)),
+    )
+
+
+def llama_state_dict_to_lm(hf_sd: Dict[str, Any],
+                           num_layers: int) -> Dict[str, np.ndarray]:
+    """HF Llama state_dict -> our torch-convention LM state_dict.
+
+    The q/k/v Linears concatenate row-wise into the GQA ``in_proj_weight``
+    ((E + 2*E_kv, E)); everything else is a rename (torch Linear layout on
+    both sides). ``rotary_emb.inv_freq`` buffers are ignored.
+    """
+    sd = dict(hf_sd)
+    out: Dict[str, np.ndarray] = {
+        "embedding.weight": _np(sd["model.embed_tokens.weight"]),
+        "encoder.norm.weight": _np(sd["model.norm.weight"]),
+    }
+    if "lm_head.weight" in sd:
+        out["lm_head.weight"] = _np(sd["lm_head.weight"])
+    for i in range(num_layers):
+        src, dst = f"model.layers.{i}", f"encoder.layers.{i}"
+        out[f"{dst}.norm1.weight"] = _np(sd[f"{src}.input_layernorm.weight"])
+        out[f"{dst}.norm2.weight"] = \
+            _np(sd[f"{src}.post_attention_layernorm.weight"])
+        out[f"{dst}.self_attn.in_proj_weight"] = np.concatenate([
+            _np(sd[f"{src}.self_attn.q_proj.weight"]),
+            _np(sd[f"{src}.self_attn.k_proj.weight"]),
+            _np(sd[f"{src}.self_attn.v_proj.weight"])], axis=0)
+        out[f"{dst}.self_attn.out_proj.weight"] = \
+            _np(sd[f"{src}.self_attn.o_proj.weight"])
+        out[f"{dst}.linear1.weight"] = _np(sd[f"{src}.mlp.gate_proj.weight"])
+        out[f"{dst}.linear_gate.weight"] = _np(sd[f"{src}.mlp.up_proj.weight"])
+        out[f"{dst}.linear2.weight"] = _np(sd[f"{src}.mlp.down_proj.weight"])
+    return out
+
+
+def load_llama(config: Dict[str, Any], state_dict: Dict[str, Any]) -> Module:
+    """Build a ``build_lm`` model from an HF Llama config + state_dict."""
+    from bigdl_tpu.models.transformer import build_lm
+    kwargs = llama_lm_kwargs(config)
+    model = build_lm(**kwargs)
+    ours = llama_state_dict_to_lm(state_dict, kwargs["num_layers"])
+    # tied checkpoints carry no lm_head.weight; untied must have it
+    strict = not kwargs["tie_embeddings"]
+    return import_lm_state_dict(model, ours, strict=strict)
+
+
+# ------------------------------------------------------------- directory I/O
+
+def _read_hf_weights(path: str) -> Dict[str, np.ndarray]:
+    """Read an HF checkpoint directory's weights (safetensors preferred,
+    single- or multi-shard; falls back to ``pytorch_model.bin``)."""
+    st = [f for f in sorted(os.listdir(path)) if f.endswith(".safetensors")]
+    if st:
+        from safetensors.numpy import load_file
+        out: Dict[str, np.ndarray] = {}
+        for f in st:
+            out.update(load_file(os.path.join(path, f)))
+        return out
+    bins = [f for f in sorted(os.listdir(path)) if f.endswith(".bin")
+            and f.startswith("pytorch_model")]
+    if bins:
+        import torch
+        out = {}
+        for f in bins:
+            out.update(torch.load(os.path.join(path, f),
+                                  map_location="cpu", weights_only=True))
+        return out
+    raise FileNotFoundError(f"no .safetensors or pytorch_model*.bin in {path}")
+
+
+def load_hf_checkpoint(path: str) -> Module:
+    """Load an HF checkpoint DIRECTORY (config.json + weights) into a
+    ``build_lm`` model. Dispatches on ``config.json``'s ``model_type``:
+    ``gpt2`` or the Llama family (``llama``/``mistral``-shaped configs
+    that satisfy ``llama_lm_kwargs``)."""
+    with open(os.path.join(path, "config.json")) as f:
+        config = json.load(f)
+    sd = _read_hf_weights(path)
+    mt = config.get("model_type", "")
+    if mt == "gpt2":
+        return load_gpt2(config, sd)
+    if mt in ("llama", "mistral"):
+        return load_llama(config, sd)
+    raise ValueError(f"unsupported model_type {mt!r} (gpt2/llama/mistral)")

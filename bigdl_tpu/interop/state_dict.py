@@ -41,7 +41,8 @@ from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
-from bigdl_tpu.nn.attention import MultiHeadAttention, TransformerEncoder
+from bigdl_tpu.nn.attention import (LearnedPositionalEncoding,
+                                    MultiHeadAttention, TransformerEncoder)
 from bigdl_tpu.nn.linear import (LMHead, Linear, LookupTable,
                                  TiedLMHead)
 from bigdl_tpu.nn.module import Module
@@ -72,6 +73,12 @@ def _named_params(model: Module) -> List[Tuple[str, Module, str]]:
     emb, enc, head = _lm_parts(model)
     out: List[Tuple[str, Module, str]] = [
         ("embedding.weight", emb, "weight")]
+    # GPT-2-style learned position table (build_lm(pos="learned")); the
+    # sinusoidal PositionalEncoding is a constant and serialises nothing
+    wpes = [m for m in model.modules()
+            if isinstance(m, LearnedPositionalEncoding)]
+    if wpes:
+        out.append(("pos_embedding.weight", wpes[0], "weight"))
     for i in range(enc.num_layers):
         layer = enc._modules[f"layer{i}"]
         if getattr(layer, "moe_experts", 0):

@@ -26,9 +26,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from bigdl_tpu.nn.attention import MultiHeadAttention, PositionalEncoding
-from bigdl_tpu.nn.linear import LMHead, TiedLMHead
-from bigdl_tpu.nn.module import Module, functional_apply
+from bigdl_tpu.nn.attention import _AddedPositionBase, MultiHeadAttention
+from bigdl_tpu.nn.linear import LMHead, Linear, TiedLMHead
+from bigdl_tpu.nn.module import Module, _apply_lock, functional_apply
 from bigdl_tpu.nn.recurrent import TimeDistributed
 
 
@@ -72,17 +72,34 @@ def sample_token(logprobs: jax.Array, key: Optional[jax.Array], *,
 
 def _decode_modules(model: Module):
     mhas = [m for m in model.modules() if isinstance(m, MultiHeadAttention)]
-    pes = [m for m in model.modules() if isinstance(m, PositionalEncoding)]
+    pes = [m for m in model.modules() if isinstance(m, _AddedPositionBase)]
     # LM-head tails compute only the LAST position while decoding — the
     # prefill otherwise materialises (B, S0, V) log-probs just to sample
-    # one token (TimeDistributed slices likewise: in an autoregressive LM
-    # it only ever appears as the vocab head)
+    # one token
     heads = [m for m in model.modules()
-             if isinstance(m, (LMHead, TiedLMHead, TimeDistributed))]
+             if isinstance(m, (LMHead, TiedLMHead))]
+    # A TimeDistributed is last-position-sliced ONLY when it is plausibly
+    # the vocab head (inner Linear, exactly one instance) — slicing a
+    # mid-network TimeDistributed would silently corrupt generations.
+    tds = [m for m in model.modules() if isinstance(m, TimeDistributed)]
+    if tds:
+        if len(tds) > 1:
+            raise ValueError(
+                f"model has {len(tds)} TimeDistributed modules; generate() "
+                "can only last-position-slice a single LM-head tail "
+                "(TimeDistributed(Linear) as the vocab projection)")
+        if isinstance(getattr(tds[0], "inner", None), Linear):
+            heads.append(tds[0])
+        # non-Linear inner: leave it alone — it computes every position
     if not mhas:
         raise ValueError("generate() needs a model with MultiHeadAttention "
                          "layers (see models/transformer.build_lm)")
     return mhas, pes, heads
+
+
+def _pos_table_len(pe) -> int:
+    """Capacity (max positions) of any additive positional encoding."""
+    return pe.pos_table().shape[0]
 
 
 def _build_decode_fn(model: Module, max_new_tokens: int, temperature: float,
@@ -303,14 +320,20 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
     total = s0 + max_new_tokens
     mhas, pes, heads = _decode_modules(model)
     for pe in pes:
-        if pe.pe.shape[0] < total:
+        if _pos_table_len(pe) < total:
             raise ValueError(
-                f"model max_len {pe.pe.shape[0]} < prompt+max_new_tokens "
+                f"model max_len {_pos_table_len(pe)} < prompt+max_new_tokens "
                 f"{total}; rebuild the model with a larger max_len")
     if pad_id is None:
         pad_id = eos_id if eos_id is not None else 1
 
     was_training = model.training
+    # the whole enable_decode -> functional_state -> run -> disable_decode
+    # window holds the per-root apply lock (reentrant — functional_state
+    # re-acquires it): a concurrent predict/evaluate/generate on the same
+    # instance must not observe half-toggled decode state
+    _lock = _apply_lock(model)
+    _lock.acquire()
     try:
         model.evaluate_mode()
         for m in mhas:
@@ -394,4 +417,5 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
         for m in mhas + pes + heads:
             m.disable_decode()
         model.set_training(was_training)
+        _lock.release()
     return out[0] if squeeze else out

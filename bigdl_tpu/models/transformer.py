@@ -26,7 +26,12 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
              tie_embeddings: bool = False,
              rope: bool = False, activation: str = "gelu",
              norm: str = "layer",
-             num_kv_heads: Optional[int] = None) -> nn.Sequential:
+             num_kv_heads: Optional[int] = None,
+             rope_theta: float = 10000.0,
+             pos: str = "sinusoidal",
+             bias: bool = True,
+             head_bias: Optional[bool] = None,
+             norm_eps: Optional[float] = None) -> nn.Sequential:
     """Causal LM: 1-based token ids (N, T) -> log-probs (N, T, vocab).
 
     ``seq_axis="seq"`` shards every attention layer over the mesh sequence
@@ -56,11 +61,23 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
     ``activation="swiglu"`` + ``norm="rms"`` + ``rope=True`` +
     ``tie_embeddings=True`` is the Llama-family block recipe — every
     piece composes with the fused-CE tail, KV-cached generation, and
-    int8 quantization."""
+    int8 quantization.
+
+    Checkpoint-parity knobs (``interop/hf.py`` builds with these):
+    ``pos="learned"`` uses a trained GPT-2-style ``wpe`` table instead of
+    the sinusoidal encoding (ignored under ``rope``); ``bias=False``
+    drops every affine bias (Llama convention); ``rope_theta`` sets the
+    rotary frequency base (500000 for Llama-3-era models);
+    ``head_bias`` overrides ``bias`` for the untied LM head."""
     embed = nn.LookupTable(vocab_size, embed_dim)
     m = nn.Sequential().add(embed)
     if not rope:
-        m.add(nn.PositionalEncoding(embed_dim, max_len, dropout))
+        if pos == "learned":
+            m.add(nn.LearnedPositionalEncoding(embed_dim, max_len, dropout))
+        elif pos == "sinusoidal":
+            m.add(nn.PositionalEncoding(embed_dim, max_len, dropout))
+        else:
+            raise ValueError(f"unknown pos {pos!r}: 'sinusoidal' or 'learned'")
     elif dropout:
         # keep the embedding-stream dropout the PE module would have applied
         m.add(nn.Dropout(dropout))
@@ -71,10 +88,14 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
                                 seq_layout=seq_layout,
                                 moe_experts=moe_experts,
                                 moe_k=moe_k, rope=rope,
-                                num_kv_heads=num_kv_heads))
+                                num_kv_heads=num_kv_heads,
+                                rope_theta=rope_theta, bias=bias,
+                                norm_eps=norm_eps))
     if tie_embeddings:
         return m.add(nn.TiedLMHead(embed))
+    hb = bias if head_bias is None else head_bias
     if fused_head:
-        return m.add(nn.LMHead(embed_dim, vocab_size))
-    return (m.add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size)))
+        return m.add(nn.LMHead(embed_dim, vocab_size, with_bias=hb))
+    return (m.add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size,
+                                               with_bias=hb)))
             .add(nn.LogSoftMax()))

@@ -73,5 +73,5 @@ from bigdl_tpu.nn.recurrent import (
 )
 from bigdl_tpu.nn.attention import (
     LayerNorm, RMSNorm, MultiHeadAttention, PositionalEncoding,
-    TransformerEncoderLayer, TransformerEncoder,
+    LearnedPositionalEncoding, TransformerEncoderLayer, TransformerEncoder,
 )

@@ -108,14 +108,18 @@ class MultiHeadAttention(Module):
                  causal: bool = False, block_size: int = 0,
                  seq_axis: Optional[str] = None, seq_mode: str = "ring",
                  seq_layout: str = "contiguous", rope: bool = False,
-                 num_kv_heads: Optional[int] = None):
+                 num_kv_heads: Optional[int] = None,
+                 rope_theta: float = 10000.0):
         super().__init__()
         assert embed_dim % num_heads == 0, "embed_dim must divide num_heads"
         # GQA (grouped-query attention): num_kv_heads < num_heads shares
         # each k/v head across num_heads // num_kv_heads query heads — the
         # KV cache (decode's memory hog) shrinks by that factor. The
-        # in_proj weight is then (E + 2*E_kv, E) instead of torch's 3E
-        # stacking, so torch-layout interchange only holds for full MHA.
+        # in_proj weight is (E + 2*E_kv, E): torch nn.MultiheadAttention's
+        # 3E stacking only when full MHA, and exactly the row-concat of HF
+        # Llama's q/k/v projections in general — real grouped-query
+        # checkpoints load via interop/hf.py (parity-tested against
+        # transformers in tests/test_hf_interop.py).
         self.num_kv_heads = num_kv_heads or num_heads
         if num_heads % self.num_kv_heads != 0:
             raise ValueError(f"num_kv_heads {self.num_kv_heads} must divide "
@@ -130,6 +134,7 @@ class MultiHeadAttention(Module):
             raise ValueError("rope is not supported with context-parallel "
                              "attention yet (per-shard global positions)")
         self.rope = rope
+        self.rope_theta = rope_theta
         # seq_axis: mesh axis name for context parallelism. When set, the
         # module must run inside shard_map with activations sharded
         # (B, S/P, E) on that axis; attention goes through
@@ -303,8 +308,9 @@ class MultiHeadAttention(Module):
             pos = jnp.arange(q.shape[1])
             if self._decode:
                 pos = pos + self.decode_pos
-            q = rope_rotate(q, pos)
-            k = rope_rotate(k, pos)
+            theta = getattr(self, "rope_theta", 10000.0)
+            q = rope_rotate(q, pos, theta)
+            k = rope_rotate(k, pos, theta)
 
         if self._decode:
             ctx = self._attend_decode(q, k, v)
@@ -347,10 +353,71 @@ class MultiHeadAttention(Module):
                 f"{', causal' if self.causal else ''})")
 
 
-class PositionalEncoding(TensorModule):
-    """Sinusoidal position encoding added to (B, S, E) input."""
+class _AddedPositionBase(TensorModule):
+    """Shared machinery for additive position encodings: a (max_len, E)
+    table added to (B, S, E) input, with the incremental-decode offset
+    protocol (positions continue from a buffer-tracked ``decode_pos``,
+    threaded functionally by ``functional_apply`` like the KV cache).
+    Subclasses store the table (parameter or buffer) and expose it via
+    ``pos_table()``."""
 
     _decode = False  # class attr: see MultiHeadAttention._decode
+
+    def pos_table(self) -> jax.Array:
+        raise NotImplementedError
+
+    def enable_decode(self):
+        self._decode = True
+        self.register_buffer("decode_pos", jnp.zeros((), jnp.int32))
+        return self
+
+    def disable_decode(self):
+        self._decode = False
+        self._buffers.pop("decode_pos", None)
+        return self
+
+    def update_output(self, input):
+        s = input.shape[1]
+        table = self.pos_table()
+        if self._decode:
+            pos = self.decode_pos
+            pe = jax.lax.dynamic_slice(table, (pos, 0), (s, table.shape[1]))
+            self.decode_pos = pos + s
+        else:
+            pe = table[:s]
+        return self.dropout.forward(input + pe.astype(input.dtype))
+
+
+class LearnedPositionalEncoding(_AddedPositionBase):
+    """Learned absolute position embeddings — the GPT-2 ``wpe`` table. A
+    trained (max_len, E) PARAMETER, unlike the fixed sinusoidal
+    ``PositionalEncoding``; required to load GPT-2-family checkpoints
+    (``interop/hf.py``). GPT-2-style N(0, 0.02) init drawn from the
+    process ``RandomGenerator`` so ``manual_seed`` governs it like every
+    other parameter."""
+
+    def __init__(self, embed_dim: int, max_len: int = 1024,
+                 dropout: float = 0.0):
+        super().__init__()
+        from bigdl_tpu.nn.regularization import Dropout
+        from bigdl_tpu.utils.rng import RandomGenerator
+        self.dropout = Dropout(dropout)
+        self.max_len, self.embed_dim = max_len, embed_dim
+        self.register_parameter(
+            "weight",
+            RandomGenerator.RNG().normal(
+                0.0, 0.02, (max_len, embed_dim)).astype(np.float32))
+
+    def pos_table(self) -> jax.Array:
+        return self.weight
+
+    def __repr__(self):
+        return (f"LearnedPositionalEncoding({self.embed_dim}, "
+                f"max_len={self.max_len})")
+
+
+class PositionalEncoding(_AddedPositionBase):
+    """Sinusoidal position encoding added to (B, S, E) input."""
 
     def __init__(self, embed_dim: int, max_len: int = 4096,
                  dropout: float = 0.0):
@@ -364,26 +431,8 @@ class PositionalEncoding(TensorModule):
         pe[:, 1::2] = np.cos(pos * div[: embed_dim // 2])
         self.register_buffer("pe", pe)
 
-    def enable_decode(self) -> "PositionalEncoding":
-        """Incremental mode: positions continue from a buffer-tracked offset
-        (threaded functionally by ``functional_apply``, like the KV cache)."""
-        self._decode = True
-        self.register_buffer("decode_pos", jnp.zeros((), jnp.int32))
-        return self
-
-    def disable_decode(self) -> "PositionalEncoding":
-        self._decode = False
-        self._buffers.pop("decode_pos", None)
-        return self
-
-    def update_output(self, input):
-        s = input.shape[1]
-        if self._decode:
-            pos = self.decode_pos
-            pe = jax.lax.dynamic_slice(self.pe, (pos, 0), (s, self.pe.shape[1]))
-            self.decode_pos = pos + s
-            return self.dropout.forward(input + pe.astype(input.dtype))
-        return self.dropout.forward(input + self.pe[:s].astype(input.dtype))
+    def pos_table(self) -> jax.Array:
+        return self.pe
 
 
 class TransformerEncoderLayer(Module):
@@ -395,7 +444,9 @@ class TransformerEncoderLayer(Module):
                  block_size: int = 0, seq_axis: Optional[str] = None,
                  seq_mode: str = "ring", seq_layout: str = "contiguous",
                  moe_experts: int = 0, moe_k: int = 2, rope: bool = False,
-                 norm: str = "layer", num_kv_heads: Optional[int] = None):
+                 norm: str = "layer", num_kv_heads: Optional[int] = None,
+                 rope_theta: float = 10000.0, bias: bool = True,
+                 norm_eps: Optional[float] = None):
         super().__init__()
         from bigdl_tpu.nn.linear import Linear
         from bigdl_tpu.nn.regularization import Dropout
@@ -403,6 +454,8 @@ class TransformerEncoderLayer(Module):
         self.drop = Dropout(dropout)
         self.activation = activation
         self.moe_experts = moe_experts
+        # bias=False drops EVERY affine bias in the block (attention in/out
+        # projections and the FFN linears) — the Llama-family convention.
         self.self_attn = MultiHeadAttention(embed_dim, num_heads,
                                             dropout=dropout, causal=causal,
                                             block_size=block_size,
@@ -410,7 +463,9 @@ class TransformerEncoderLayer(Module):
                                             seq_mode=seq_mode,
                                             seq_layout=seq_layout,
                                             rope=rope,
-                                            num_kv_heads=num_kv_heads)
+                                            num_kv_heads=num_kv_heads,
+                                            rope_theta=rope_theta,
+                                            with_bias=bias)
         if moe_experts:
             if activation == "swiglu":
                 raise ValueError("swiglu FFN does not compose with MoE yet")
@@ -421,18 +476,20 @@ class TransformerEncoderLayer(Module):
             self.moe = MoE(embed_dim, ffn_dim, n_experts=moe_experts,
                            k=moe_k, activation=activation)
         else:
-            self.linear1 = Linear(embed_dim, ffn_dim)
-            self.linear2 = Linear(ffn_dim, embed_dim)
+            self.linear1 = Linear(embed_dim, ffn_dim, with_bias=bias)
+            self.linear2 = Linear(ffn_dim, embed_dim, with_bias=bias)
             if activation == "swiglu":
                 # Llama-style gated FFN: W2(silu(W1 x) * Wg x); the gate is
                 # a third column-parallel projection
-                self.linear_gate = Linear(embed_dim, ffn_dim)
+                self.linear_gate = Linear(embed_dim, ffn_dim, with_bias=bias)
         if norm == "layer":
-            self.norm1 = LayerNorm(embed_dim)
-            self.norm2 = LayerNorm(embed_dim)
+            eps = 1e-5 if norm_eps is None else norm_eps
+            self.norm1 = LayerNorm(embed_dim, eps=eps)
+            self.norm2 = LayerNorm(embed_dim, eps=eps)
         elif norm == "rms":
-            self.norm1 = RMSNorm(embed_dim)
-            self.norm2 = RMSNorm(embed_dim)
+            eps = 1e-6 if norm_eps is None else norm_eps
+            self.norm1 = RMSNorm(embed_dim, eps=eps)
+            self.norm2 = RMSNorm(embed_dim, eps=eps)
         else:
             raise ValueError(f"unknown norm {norm!r}: 'layer' or 'rms'")
 
@@ -486,7 +543,9 @@ class TransformerEncoder(Module):
                  block_size: int = 0, seq_axis: Optional[str] = None,
                  seq_mode: str = "ring", seq_layout: str = "contiguous",
                  moe_experts: int = 0, moe_k: int = 2, rope: bool = False,
-                 norm: str = "layer", num_kv_heads: Optional[int] = None):
+                 norm: str = "layer", num_kv_heads: Optional[int] = None,
+                 rope_theta: float = 10000.0, bias: bool = True,
+                 norm_eps: Optional[float] = None):
         super().__init__()
         self.num_layers = num_layers
         for i in range(num_layers):
@@ -495,13 +554,16 @@ class TransformerEncoder(Module):
                 activation=activation, pre_norm=pre_norm, causal=causal,
                 block_size=block_size, seq_axis=seq_axis, seq_mode=seq_mode,
                 seq_layout=seq_layout, moe_experts=moe_experts, moe_k=moe_k,
-                rope=rope, norm=norm, num_kv_heads=num_kv_heads))
+                rope=rope, norm=norm, num_kv_heads=num_kv_heads,
+                rope_theta=rope_theta, bias=bias, norm_eps=norm_eps))
         if not pre_norm:
             self.final_norm = None
         elif norm == "rms":
-            self.final_norm = RMSNorm(embed_dim)
+            self.final_norm = RMSNorm(
+                embed_dim, eps=1e-6 if norm_eps is None else norm_eps)
         else:
-            self.final_norm = LayerNorm(embed_dim)
+            self.final_norm = LayerNorm(
+                embed_dim, eps=1e-5 if norm_eps is None else norm_eps)
         if self.final_norm is not None:
             self.add_module("final_norm", self.final_norm)
 
@@ -514,16 +576,22 @@ class TransformerEncoder(Module):
         return x
 
 
-def rope_rotate(x: jax.Array, positions: jax.Array) -> jax.Array:
+def rope_rotate(x: jax.Array, positions: jax.Array,
+                theta: float = 10000.0) -> jax.Array:
     """Rotary position embedding (RoPE, Su et al.): rotate feature pairs of
     ``x`` (B, S, H, D) by angles proportional to absolute ``positions``
     (S,). Because rotations compose, q@k between positions i and j depends
     only on i - j — the relative-position property that makes RoPE the
     modern LM standard. Applied to q/k BEFORE attention (and before the KV
-    cache write, so cached keys carry their absolute rotation)."""
+    cache write, so cached keys carry their absolute rotation).
+
+    The pairing convention is HF-Llama's "rotate_half" (pair feature i
+    with i + D/2), so Llama-family checkpoints import without any q/k
+    permutation (``interop/hf.py``). ``theta`` is the frequency base:
+    10000 for Llama-1/2-era models, 500000 for Llama-3."""
     d = x.shape[-1]
     half = d // 2
-    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
     angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (S, half)
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]

@@ -1,0 +1,44 @@
+"""Regenerate the vendored tiny HF GPT-2 checkpoint fixture.
+
+Run from the repo root:  python tests/resources/make_hf_fixture.py
+
+Writes ``tests/resources/hf_tiny_gpt2/``: a REAL ``transformers``
+``GPT2LMHeadModel`` (deterministically seeded) saved as config.json +
+model.safetensors, plus golden input ids and the torch model's own
+log-probs. ``tests/test_hf_interop.py::TestVendoredCheckpoint`` loads the
+directory through ``interop.hf.load_hf_checkpoint`` (no torch involved)
+and must reproduce the golden outputs.
+"""
+
+import json
+import os
+
+import numpy as np
+import torch
+from transformers import GPT2Config, GPT2LMHeadModel
+
+OUT = os.path.join(os.path.dirname(__file__), "hf_tiny_gpt2")
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    torch.manual_seed(0)
+    cfg = GPT2Config(vocab_size=97, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4)
+    model = GPT2LMHeadModel(cfg).eval()
+    model.save_pretrained(OUT, safe_serialization=True)
+    ids = np.random.default_rng(0).integers(0, 97, (2, 24))
+    with torch.no_grad():
+        lp = torch.log_softmax(model(torch.as_tensor(ids)).logits, -1)
+    np.save(os.path.join(OUT, "golden_input_ids.npy"), ids)
+    np.save(os.path.join(OUT, "golden_logprobs.npy"), lp.numpy())
+    # keep only what the loader + test need
+    for junk in ("generation_config.json",):
+        p = os.path.join(OUT, junk)
+        if os.path.exists(p):
+            os.remove(p)
+    print("wrote", OUT, os.listdir(OUT))
+
+
+if __name__ == "__main__":
+    main()

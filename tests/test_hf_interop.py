@@ -1,0 +1,192 @@
+"""HF checkpoint import parity — the round-4 interop capstone.
+
+The reference proves its interop by loading real Caffe/Torch checkpoints and
+comparing outputs (``$T/integration``, ``utils/CaffeLoader.scala:132``). Here
+the oracle is LIVE ``transformers`` torch models (CPU): build a real HF
+GPT-2 / Llama model, import its state_dict through ``interop/hf.py``, and
+require LOGIT-level agreement, identical greedy generations, and matching
+perplexity. A vendored safetensors checkpoint additionally proves the
+directory loader against golden outputs with no torch in the loop.
+
+All comparisons run under ``jax.default_matmul_precision("highest")``: the
+CPU backend's default matmul precision is reduced (oneDNN bf16-like), which
+is the intended TPU compute policy but would mask layout bugs behind 1e-2
+noise here.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu.interop.hf import (load_gpt2, load_hf_checkpoint, load_llama,
+                                  to_framework_ids, to_hf_ids)
+
+RES = os.path.join(os.path.dirname(__file__), "resources", "hf_tiny_gpt2")
+
+# NOTE: only the live-oracle classes need torch/transformers; the vendored-
+# checkpoint class below runs torch-free (that being its entire point), so
+# the importorskip lives in this helper, not at module level.
+
+
+def _torch():
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    return torch
+
+
+def tiny_gpt2(seed=0):
+    torch = _torch()
+    from transformers import GPT2Config, GPT2LMHeadModel
+    torch.manual_seed(seed)
+    cfg = GPT2Config(vocab_size=97, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4)
+    return cfg, GPT2LMHeadModel(cfg).eval()
+
+
+def tiny_llama(seed=0, n_kv=2, tie=False):
+    torch = _torch()
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(seed)
+    cfg = LlamaConfig(vocab_size=89, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=n_kv,
+                      max_position_embeddings=64,
+                      rms_norm_eps=1e-5, rope_theta=10000.0,
+                      tie_word_embeddings=tie)
+    return cfg, LlamaForCausalLM(cfg).eval()
+
+
+def hf_logprobs(hf, ids):
+    import torch
+    with torch.no_grad():
+        return torch.log_softmax(hf(torch.as_tensor(ids)).logits,
+                                 -1).numpy()
+
+
+def our_logprobs(model, hf_ids):
+    model.evaluate_mode()
+    return np.asarray(model.forward(to_framework_ids(hf_ids)))
+
+
+class TestGPT2Parity:
+    def test_logit_parity(self):
+        cfg, hf = tiny_gpt2()
+        ids = np.random.default_rng(0).integers(0, 97, (2, 24))
+        model = load_gpt2(cfg.to_dict(), hf.state_dict())
+        with jax.default_matmul_precision("highest"):
+            ours = our_logprobs(model, ids)
+        ref = hf_logprobs(hf, ids)
+        assert ours.shape == ref.shape
+        assert np.abs(ours - ref).max() < 5e-5
+
+    def test_greedy_generation_identical(self):
+        cfg, hf = tiny_gpt2(seed=3)
+        model = load_gpt2(cfg.to_dict(), hf.state_dict())
+        prompt = np.array([[5, 17, 42, 8]])
+        import torch
+        with torch.no_grad():
+            ref = hf.generate(torch.as_tensor(prompt), max_new_tokens=12,
+                              do_sample=False, pad_token_id=0).numpy()
+        from bigdl_tpu.models.generation import generate
+        with jax.default_matmul_precision("highest"):
+            out = generate(model, to_framework_ids(prompt),
+                           max_new_tokens=12, greedy=True)
+        assert np.array_equal(to_hf_ids(np.asarray(out)), ref)
+
+    def test_perplexity_parity(self):
+        cfg, hf = tiny_gpt2(seed=5)
+        model = load_gpt2(cfg.to_dict(), hf.state_dict())
+        ids = np.random.default_rng(7).integers(0, 97, (1, 32))
+        import torch
+        # HF: mean NLL of next-token prediction
+        with torch.no_grad():
+            t = torch.as_tensor(ids)
+            ref_nll = hf(t, labels=t).loss.item()
+        with jax.default_matmul_precision("highest"):
+            lp = our_logprobs(model, ids)
+        ours_nll = -np.mean(lp[0, np.arange(31), ids[0, 1:]])
+        assert abs(ours_nll - ref_nll) < 1e-4
+        assert abs(np.exp(ours_nll) - np.exp(ref_nll)) < 1e-3
+
+    def test_rejects_unknown_activation(self):
+        cfg, hf = tiny_gpt2()
+        d = cfg.to_dict()
+        d["activation_function"] = "relu"
+        with pytest.raises(ValueError, match="activation"):
+            load_gpt2(d, hf.state_dict())
+
+
+class TestLlamaParity:
+    """Kills round-3's declared GQA torch-incompatibility: real HF Llama
+    checkpoints (grouped k/v) load by row-concatenation into in_proj."""
+
+    def test_gqa_logit_parity(self):
+        cfg, hf = tiny_llama(n_kv=2)
+        ids = np.random.default_rng(1).integers(0, 89, (2, 20))
+        model = load_llama(cfg.to_dict(), hf.state_dict())
+        with jax.default_matmul_precision("highest"):
+            ours = our_logprobs(model, ids)
+        ref = hf_logprobs(hf, ids)
+        assert np.abs(ours - ref).max() < 5e-5
+
+    def test_mha_logit_parity(self):
+        cfg, hf = tiny_llama(n_kv=4)  # full MHA variant
+        ids = np.random.default_rng(2).integers(0, 89, (1, 16))
+        model = load_llama(cfg.to_dict(), hf.state_dict())
+        with jax.default_matmul_precision("highest"):
+            ours = our_logprobs(model, ids)
+        assert np.abs(ours - hf_logprobs(hf, ids)).max() < 5e-5
+
+    def test_tied_embeddings_variant(self):
+        cfg, hf = tiny_llama(n_kv=2, tie=True)
+        ids = np.random.default_rng(3).integers(0, 89, (1, 12))
+        model = load_llama(cfg.to_dict(), hf.state_dict())
+        with jax.default_matmul_precision("highest"):
+            ours = our_logprobs(model, ids)
+        assert np.abs(ours - hf_logprobs(hf, ids)).max() < 5e-5
+
+    def test_gqa_greedy_generation_identical(self):
+        cfg, hf = tiny_llama(seed=11, n_kv=2)
+        model = load_llama(cfg.to_dict(), hf.state_dict())
+        prompt = np.array([[3, 44, 7]])
+        import torch
+        with torch.no_grad():
+            ref = hf.generate(torch.as_tensor(prompt), max_new_tokens=10,
+                              do_sample=False, pad_token_id=0).numpy()
+        from bigdl_tpu.models.generation import generate
+        with jax.default_matmul_precision("highest"):
+            out = generate(model, to_framework_ids(prompt),
+                           max_new_tokens=10, greedy=True)
+        assert np.array_equal(to_hf_ids(np.asarray(out)), ref)
+
+    def test_rejects_biased_variant(self):
+        cfg, hf = tiny_llama()
+        d = cfg.to_dict()
+        d["attention_bias"] = True
+        with pytest.raises(ValueError, match="bias"):
+            load_llama(d, hf.state_dict())
+
+
+class TestVendoredCheckpoint:
+    """Directory loader against the committed safetensors fixture — no
+    torch at load time, golden outputs prove end-to-end stability."""
+
+    def test_fixture_exists(self):
+        assert os.path.exists(os.path.join(RES, "config.json")), \
+            "run tests/resources/make_hf_fixture.py to regenerate"
+
+    def test_load_and_golden_logprobs(self):
+        model = load_hf_checkpoint(RES)
+        ids = np.load(os.path.join(RES, "golden_input_ids.npy"))
+        golden = np.load(os.path.join(RES, "golden_logprobs.npy"))
+        model.evaluate_mode()
+        with jax.default_matmul_precision("highest"):
+            ours = np.asarray(model.forward(to_framework_ids(ids)))
+        assert np.abs(ours - golden).max() < 5e-5
+
+    def test_id_helpers_roundtrip(self):
+        ids = np.array([[0, 5, 96]])
+        assert np.array_equal(to_hf_ids(to_framework_ids(ids)), ids)
