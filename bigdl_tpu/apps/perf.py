@@ -24,7 +24,8 @@ _LSTM_VOCAB = 20_000
 _TRANSFORMER_VOCAB = 32_000
 
 
-def _build_model(name: str, fused_head: bool = True):
+def _build_model(name: str, fused_head: bool = True, moe_experts: int = 0,
+                 moe_dispatch: str = "scatter"):
     """(model, feature_shape, n_classes, int_vocab, seq_labels) —
     ``int_vocab > 0`` marks integer token-index features (LSTM text
     classification, BASELINE config 5); ``seq_labels`` marks per-timestep
@@ -63,9 +64,39 @@ def _build_model(name: str, fused_head: bool = True):
             _TRANSFORMER_VOCAB, 1024, 16, 4096, num_layers=24, max_len=1024,
             fused_head=fused_head),
             (1024,), _TRANSFORMER_VOCAB, _TRANSFORMER_VOCAB, True),
+        # billion-scale Llama-recipe configs (GQA 2:1, RoPE, RMSNorm,
+        # SwiGLU, tied embeddings, s=2048): the one-chip capacity proof.
+        # Run with --optim adamw --optStateDtype bf16 --remat block
+        # (fp32 Adam moments alone are 8 GB/B-params — past one v5e).
+        "transformer_830m": lambda: (transformer.build_lm(
+            _TRANSFORMER_VOCAB, 2048, 16, 5632, num_layers=16, max_len=2048,
+            num_kv_heads=8, rope=True, activation="swiglu", norm="rms",
+            tie_embeddings=True),
+            (2048,), _TRANSFORMER_VOCAB, _TRANSFORMER_VOCAB, True),
+        "transformer_1b": lambda: (transformer.build_lm(
+            _TRANSFORMER_VOCAB, 2048, 16, 5632, num_layers=20, max_len=2048,
+            num_kv_heads=8, rope=True, activation="swiglu", norm="rms",
+            tie_embeddings=True),
+            (2048,), _TRANSFORMER_VOCAB, _TRANSFORMER_VOCAB, True),
     }
     if name not in builders:
         raise SystemExit(f"unknown model {name}; one of {sorted(builders)}")
+    if moe_experts:
+        if not name.startswith("transformer"):
+            raise SystemExit("--moeExperts applies to transformer models")
+        import functools
+        from bigdl_tpu.models import transformer as _t
+        orig = _t.build_lm
+        _t.build_lm = functools.partial(orig, moe_experts=moe_experts)
+        try:
+            out = builders[name]()
+        finally:
+            _t.build_lm = orig
+        from bigdl_tpu.parallel.expert import MoE
+        for m in out[0].modules():
+            if isinstance(m, MoE):
+                m.dispatch = moe_dispatch
+        return out
     return builders[name]()
 
 
@@ -83,6 +114,26 @@ def main(argv=None) -> None:
     ap.add_argument("--stepsPerDispatch", "-k", type=int, default=1,
                     help="fuse K iterations per jitted dispatch "
                     "(set_steps_per_dispatch; local runs only)")
+    ap.add_argument("--optim", choices=("sgd", "adamw"), default="sgd",
+                    help="adamw: the transformer-LM optimizer (lr 1e-4)")
+    ap.add_argument("--optStateDtype", choices=("fp32", "bf16"),
+                    default="fp32",
+                    help="adamw only: moment storage dtype (bf16 halves "
+                    "optimizer-state HBM; math stays fp32)")
+    ap.add_argument("--remat", choices=("none", "full", "conv", "block"),
+                    default="none",
+                    help="activation rematerialization policy "
+                    "(block = per-transformer-block, the LM memory knob)")
+    ap.add_argument("--memStats", action="store_true",
+                    help="print device memory_stats after the run (HBM "
+                    "accounting for capacity studies)")
+    ap.add_argument("--moeExperts", type=int, default=0,
+                    help="transformer models: top-k routed MoE FFN with "
+                    "this many experts (gelu models only)")
+    ap.add_argument("--moeDispatch", choices=("scatter", "einsum"),
+                    default="scatter",
+                    help="MoE token dispatch: ragged scatter (default) or "
+                    "dense GShard einsum masks")
     ap.add_argument("--no-fused-head", action="store_true",
                     help="LM only: unfused TimeDistributed(Linear)+LogSoftMax"
                     " tail + ClassNLL instead of LMHead+FusedLMHeadCriterion")
@@ -103,7 +154,8 @@ def main(argv=None) -> None:
 
     redirect_logs()
     model, shape, n_class, int_vocab, seq_labels = _build_model(
-        args.model, fused_head=not args.no_fused_head)
+        args.model, fused_head=not args.no_fused_head,
+        moe_experts=args.moeExperts, moe_dispatch=args.moeDispatch)
 
     rng = np.random.RandomState(0)
     # enough records that a K-fused window fits inside one epoch (epoch
@@ -160,7 +212,15 @@ def main(argv=None) -> None:
     else:
         from bigdl_tpu.optim import Optimizer
         opt = Optimizer(model, ds, criterion)
-    opt.set_optim_method(SGD(learningrate=0.01))
+    if args.optim == "adamw":
+        from bigdl_tpu.optim import AdamW
+        opt.set_optim_method(AdamW(
+            learningrate=1e-4,
+            state_dtype="bfloat16" if args.optStateDtype == "bf16" else None))
+    else:
+        opt.set_optim_method(SGD(learningrate=0.01))
+    if args.remat != "none":
+        opt.set_remat(True if args.remat == "full" else args.remat)
     if args.stepsPerDispatch > 1:
         opt.set_steps_per_dispatch(args.stepsPerDispatch)
     if args.precision == "bf16":
@@ -188,6 +248,12 @@ def main(argv=None) -> None:
     t0 = time.time()
     opt.optimize()
     wall = time.time() - t0
+    if args.memStats:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        print(json.dumps({"memory_stats": {
+            k: stats[k] for k in ("bytes_in_use", "peak_bytes_in_use",
+                                  "bytes_limit", "largest_alloc_size")
+            if k in stats}}), file=sys.stderr)
     # a K-fused window spreads its dispatch time over K per-iteration
     # entries: the first (compile-bearing) window must be excluded WHOLE or
     # its tail contaminates the steady state (measured: 1554 vs the true

@@ -314,7 +314,12 @@ def generate_cmd(argv) -> None:
         if args.eosId is None:
             args.eosId = tok.eos_id
     else:
-        ids = [float(t) for t in args.prompt.split(",")]
+        ids = [float(t) for t in args.prompt.split(",") if t.strip()]
+    if not ids:
+        raise SystemExit("empty prompt: pass at least one token (text with "
+                         "--tokenizer, else comma-separated 1-based ids); a "
+                         "(1, 0) prompt would fail deep in the prefill with "
+                         "an opaque shape error")
     prompt = jnp.asarray([ids])
     out = generate(model, prompt, args.maxNewTokens,
                    temperature=args.temperature, top_k=args.topK,
@@ -334,12 +339,79 @@ def generate_cmd(argv) -> None:
         print("continuation:", ids[n0:])
 
 
+def serve_cmd(argv) -> None:
+    """Batched HTTP serving over the KV-cached decode (``models.lm_server``;
+    the reference's udfpredictor/DLClassifier serving quadrant, LM era)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bigdl_tpu.apps.transformer serve")
+    ap.add_argument("--model", default=None,
+                    help="saved model path (file_io); default: train a "
+                    "fresh tiny LM on the synthetic grammar first")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--maxBatch", type=int, default=8,
+                    help="micro-batch cap (requests gathered per dispatch)")
+    ap.add_argument("--batchTimeoutMs", type=float, default=20.0,
+                    help="how long a dispatch waits for same-length company")
+    ap.add_argument("--maxNewTokens", type=int, default=64,
+                    help="decode budget per batch (per-request limits trim)")
+    ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--topK", type=int, default=0)
+    ap.add_argument("--topP", type=float, default=0.0)
+    ap.add_argument("--greedy", action="store_true")
+    ap.add_argument("--eosId", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--int8", action="store_true",
+                    help="serve the int8 weight-only quantized twin")
+    ap.add_argument("--tokenizer", default=None,
+                    help="BPE tokenizer path: requests may then POST "
+                    '{"text": ...} and responses include decoded text')
+    args = ap.parse_args(argv)
+
+    from bigdl_tpu.models.lm_server import LMServer, make_http_server
+
+    if args.model:
+        model = file_io.load(args.model)
+    else:
+        print("no --model given: training a tiny LM on the synthetic "
+              "grammar first", file=sys.stderr)
+        model = train(["-b", "8", "--seqLen", "32", "--maxEpoch", "1"])
+    if args.int8:
+        model = nn.quantize_model(model)
+    tok = None
+    if args.tokenizer:
+        from bigdl_tpu.dataset.bpe import BPETokenizer
+        tok = BPETokenizer.load(args.tokenizer)
+        if args.eosId is None:
+            args.eosId = tok.eos_id
+    server = LMServer(model, max_batch=args.maxBatch,
+                      batch_timeout_ms=args.batchTimeoutMs,
+                      max_new_tokens=args.maxNewTokens,
+                      temperature=args.temperature, top_k=args.topK,
+                      top_p=args.topP, greedy=args.greedy,
+                      eos_id=args.eosId, seed=args.seed)
+    httpd = make_http_server(server, args.host, args.port, tokenizer=tok)
+    print(f"serving on http://{args.host}:{httpd.server_address[1]} "
+          f"(POST /generate, GET /health)", file=sys.stderr)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        server.close()
+
+
 def main() -> None:
-    if len(sys.argv) < 2 or sys.argv[1] not in ("train", "generate"):
-        raise SystemExit(
-            "usage: python -m bigdl_tpu.apps.transformer {train|generate} ...")
+    if len(sys.argv) < 2 or sys.argv[1] not in ("train", "generate",
+                                                "serve"):
+        raise SystemExit("usage: python -m bigdl_tpu.apps.transformer "
+                         "{train|generate|serve} ...")
     if sys.argv[1] == "generate":
         generate_cmd(sys.argv[2:])
+    elif sys.argv[1] == "serve":
+        serve_cmd(sys.argv[2:])
     else:
         train(sys.argv[2:])
 

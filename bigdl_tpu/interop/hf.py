@@ -78,6 +78,14 @@ def gpt2_lm_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
     act = config.get("activation_function", "gelu_new")
     if act not in ("gelu_new", "gelu_pytorch_tanh", "gelu"):
         raise ValueError(f"unsupported GPT-2 activation {act!r}")
+    # math-changing attention variants: refuse, don't corrupt (same policy
+    # as the Llama rope_scaling/sliding_window guards below)
+    if config.get("scale_attn_by_inverse_layer_idx", False):
+        raise ValueError("scale_attn_by_inverse_layer_idx=True divides "
+                         "attention scores per layer; not mapped")
+    if not config.get("scale_attn_weights", True):
+        raise ValueError("scale_attn_weights=False (unscaled attention) "
+                         "is not mapped")
     # "gelu" (exact erf) differs from our tanh-approx at ~1e-3; GPT-2
     # proper is gelu_new, so accept and document rather than refuse
     return dict(

@@ -327,13 +327,15 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
     if pad_id is None:
         pad_id = eos_id if eos_id is not None else 1
 
-    was_training = model.training
     # the whole enable_decode -> functional_state -> run -> disable_decode
     # window holds the per-root apply lock (reentrant — functional_state
     # re-acquires it): a concurrent predict/evaluate/generate on the same
-    # instance must not observe half-toggled decode state
+    # instance must not observe half-toggled decode state. was_training is
+    # read AFTER acquiring — reading it earlier could capture another
+    # generate's transient eval mode and restore the wrong mode on exit.
     _lock = _apply_lock(model)
     _lock.acquire()
+    was_training = model.training
     try:
         model.evaluate_mode()
         for m in mhas:

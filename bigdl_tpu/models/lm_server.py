@@ -1,0 +1,243 @@
+"""Batched LM serving over the KV-cached decode — the text counterpart of
+the reference's serving quadrant (``example/udfpredictor/`` watch-mode
+structured-streaming inference, ``ml/DLClassifier.scala:35`` batched
+DataFrame transform: the reference serves images by collecting rows into
+batches and running one forward per batch; this serves prompts by
+collecting requests into micro-batches and running ONE jitted
+prefill+decode program per batch).
+
+Design (TPU-first):
+- ``models.generate`` compiles one program per (batch, prompt_len,
+  max_new, sampling) signature. The batcher therefore quantises the
+  signature space: requests are grouped by EXACT prompt length (the causal
+  prefill has no padding mask, so mixed lengths cannot share a program),
+  the batch dim is padded up to a power-of-two bucket (dummy rows — their
+  generations are dropped), and every batch decodes the server's
+  ``max_new_tokens`` (eos-frozen rows finish early; per-request limits
+  trim the result). Steady state is one compile per (prompt-length,
+  batch-bucket) pair, reused forever after.
+- batching is dynamic: the worker takes the oldest request, waits up to
+  ``batch_timeout_ms`` for same-length company, and dispatches whatever
+  gathered — single-request latency is bounded by the timeout, batch
+  throughput by ``max_batch``.
+- ``python -m bigdl_tpu.apps.transformer serve`` wires this behind a
+  stdlib HTTP endpoint (no server-framework dependency, mirroring the
+  repo's hand-rolled-wire tradition); ``LMServer`` itself is transport-
+  free and unit-testable in-process.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclass
+class _Request:
+    ids: List[int]                      # 1-based prompt token ids
+    max_new: int
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[List[int]] = None  # continuation ids (1-based)
+    error: Optional[str] = None
+
+
+class LMServer:
+    """Micro-batching front end over ``models.generate``.
+
+    ``submit()`` blocks until the request's batch has decoded and returns
+    the continuation ids (prompt excluded, eos kept, pad stripped).
+    Thread-safe; one worker thread owns the model (generate() itself is
+    apply-locked, but serialising dispatch here keeps batches dense
+    instead of racing for the chip).
+    """
+
+    def __init__(self, model, *, max_batch: int = 8,
+                 batch_timeout_ms: float = 20.0,
+                 max_new_tokens: int = 64,
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 0.0, greedy: bool = False,
+                 eos_id: Optional[int] = None, seed: int = 0):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.model = model
+        self.max_batch = max_batch
+        self.batch_timeout = batch_timeout_ms / 1000.0
+        self.max_new_tokens = max_new_tokens
+        self.sampling = dict(temperature=temperature, top_k=top_k,
+                             top_p=top_p, greedy=greedy, eos_id=eos_id)
+        self._seed = seed
+        self._n_batches = 0
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="lm-server-batcher")
+        self._worker.start()
+
+    # ------------------------------------------------------------- client API
+    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
+               timeout: Optional[float] = None) -> List[int]:
+        """Serve one prompt; returns continuation ids (1-based)."""
+        ids = [int(t) for t in prompt_ids]
+        if not ids:
+            raise ValueError("empty prompt")
+        max_new = int(self.max_new_tokens if max_new_tokens is None
+                      else max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if max_new > self.max_new_tokens:
+            raise ValueError(f"max_new_tokens {max_new} exceeds the "
+                             f"server's decode budget {self.max_new_tokens}")
+        req = _Request(ids, max_new)
+        self._queue.put(req)
+        if not req.done.wait(timeout):
+            raise TimeoutError("decode did not complete in time")
+        if req.error is not None:
+            raise RuntimeError(req.error)
+        return req.result
+
+    def close(self):
+        self._stop.set()
+        self._worker.join(timeout=5)
+        # fail anything still queued — a submit() blocked without timeout
+        # must not hang forever on a server that will never decode again
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = "server closed before the request was dispatched"
+            req.done.set()
+
+    @property
+    def batches_served(self) -> int:
+        return self._n_batches
+
+    # ---------------------------------------------------------------- batcher
+    def _gather(self) -> Optional[List[_Request]]:
+        """Oldest request + up-to-timeout same-length company."""
+        try:
+            first = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            return None
+        batch, held = [first], []
+        s = len(first.ids)
+        deadline = _now() + self.batch_timeout
+        while len(batch) < self.max_batch:
+            remaining = deadline - _now()
+            if remaining <= 0:
+                break
+            try:
+                req = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            (batch if len(req.ids) == s else held).append(req)
+        for req in held:  # different length: back on the queue, next batch
+            self._queue.put(req)
+        return batch
+
+    def _run(self):
+        while not self._stop.is_set():
+            batch = self._gather()
+            if not batch:
+                continue
+            try:
+                self._decode_batch(batch)
+            except Exception as e:  # surface to every waiter, keep serving
+                for req in batch:
+                    req.error = f"{type(e).__name__}: {e}"
+                    req.done.set()
+
+    def _decode_batch(self, batch: List[_Request]):
+        import jax
+
+        from bigdl_tpu.models.generation import generate
+        s = len(batch[0].ids)
+        # batch-bucket: pad with copies of row 0 to the next power of two —
+        # dummy rows cost compute but keep the compile cache at
+        # O(log max_batch) entries per prompt length
+        b = 1
+        while b < len(batch):
+            b *= 2
+        rows = [req.ids for req in batch]
+        rows += [rows[0]] * (b - len(rows))
+        prompt = np.asarray(rows, np.float32)
+        key = jax.random.PRNGKey(self._seed + self._n_batches)
+        out = np.asarray(generate(self.model, prompt, self.max_new_tokens,
+                                  key=key, **self.sampling)).astype(int)
+        self._n_batches += 1
+        eos = self.sampling["eos_id"]
+        for i, req in enumerate(batch):
+            cont = out[i, s:s + req.max_new].tolist()
+            if eos is not None and eos in cont:
+                cont = cont[:cont.index(eos) + 1]  # keep eos, strip pad tail
+            req.result = cont
+            req.done.set()
+
+
+def _now() -> float:
+    import time
+    return time.monotonic()
+
+
+# ------------------------------------------------------------------ HTTP rim
+
+def make_http_server(server: LMServer, host: str, port: int, tokenizer=None):
+    """Stdlib ``ThreadingHTTPServer`` speaking JSON:
+
+    POST /generate  {"prompt": [ids...]} | {"text": "..."} (needs tokenizer)
+                    optional "max_new_tokens"
+        -> {"ids": [...], "text": "..."?}
+    GET  /health    -> {"ok": true, "batches_served": N}
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet; the app logs itself
+            pass
+
+        def _reply(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path != "/health":
+                return self._reply(404, {"error": "GET /health only"})
+            self._reply(200, {"ok": True,
+                              "batches_served": server.batches_served})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._reply(404, {"error": "POST /generate only"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if "prompt" in body:
+                    ids = [int(t) for t in body["prompt"]]
+                elif "text" in body:
+                    if tokenizer is None:
+                        return self._reply(400, {
+                            "error": "text prompts need --tokenizer"})
+                    ids = list(tokenizer.encode(str(body["text"])))
+                else:
+                    return self._reply(400, {
+                        "error": "missing 'prompt' (ids) or 'text'"})
+                cont = server.submit(ids, body.get("max_new_tokens"))
+            except (ValueError, KeyError, TypeError) as e:
+                return self._reply(400, {"error": str(e)})
+            except Exception as e:
+                return self._reply(500, {"error": str(e)})
+            payload = {"ids": cont}
+            if tokenizer is not None:
+                payload["text"] = tokenizer.decode(cont)
+            self._reply(200, payload)
+
+    return ThreadingHTTPServer((host, port), Handler)

@@ -567,10 +567,26 @@ class TransformerEncoder(Module):
         if self.final_norm is not None:
             self.add_module("final_norm", self.final_norm)
 
+    #: Optimizer.set_remat("block") sets this: each block's forward runs
+    #: under jax.checkpoint, so the backward holds only per-block BOUNDARY
+    #: activations (B*S*E per layer) — the transformer activation-memory
+    #: recipe that full-forward remat cannot provide (one outer checkpoint
+    #: re-materialises every intermediate during its own replay). Training
+    #: only; requires state-free blocks (no decode caches — enable_decode
+    #: and remat_blocks are mutually exclusive by construction since decode
+    #: runs in eval mode).
+    remat_blocks = False
+
     def update_output(self, input):
         x = input
+        ckpt = self.remat_blocks and self.training
         for i in range(self.num_layers):
-            x = self._modules[f"layer{i}"].forward(x)
+            layer = self._modules[f"layer{i}"]
+            if ckpt:
+                x = jax.checkpoint(
+                    lambda h, _l=layer: _l.forward(h))(x)
+            else:
+                x = layer.forward(x)
         if self.final_norm is not None:
             x = self.final_norm.forward(x)
         return x

@@ -307,15 +307,30 @@ class Adam(OptimMethod):
     def __init__(self, learningrate: float = 1e-3,
                  learningrate_decay: float = 0.0,
                  beta1: float = 0.9, beta2: float = 0.999,
-                 epsilon: float = 1e-8, weightdecay: float = 0.0):
+                 epsilon: float = 1e-8, weightdecay: float = 0.0,
+                 state_dtype=None):
         super().__init__(learningrate, weightdecay)
         self.learningrate_decay = learningrate_decay
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        # state_dtype="bfloat16": store the m/v moments at half width — the
+        # single biggest HBM lever for billion-param training on one chip
+        # (fp32 Adam states are 8 bytes/param, more than the weights
+        # themselves). Moment MATH stays fp32: states upcast on read and
+        # round on store, so only the storage precision drops. Measured to
+        # be what moves the one-chip capacity boundary past 1B params
+        # (PERF.md round 4).
+        self.state_dtype = state_dtype
+
+    def _zeros_like_state(self, params):
+        if self.state_dtype is None:
+            return _tree_zeros(params)
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, self.state_dtype), params)
 
     def init_state(self, params):
         s = super().init_state(params)
-        s["m"] = _tree_zeros(params)
-        s["v"] = _tree_zeros(params)
+        s["m"] = self._zeros_like_state(params)
+        s["v"] = self._zeros_like_state(params)
         return s
 
     def _scheduled_lr(self, state):
@@ -327,12 +342,19 @@ class Adam(OptimMethod):
         t = state["evalCounter"] + 1
         lr = self._scheduled_lr(state)
         b1, b2 = self.beta1, self.beta2
-        m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
-        v = jax.tree_util.tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], grads)
+        sd = getattr(self, "state_dtype", None)
+        up = (lambda x: x.astype(jnp.float32)) if sd else (lambda x: x)
+        dn = (lambda x: x.astype(sd)) if sd else (lambda x: x)
+        m = jax.tree_util.tree_map(
+            lambda m_, g: dn(b1 * up(m_) + (1 - b1) * g), state["m"], grads)
+        v = jax.tree_util.tree_map(
+            lambda v_, g: dn(b2 * up(v_) + (1 - b2) * g * g),
+            state["v"], grads)
         bc1 = 1 - b1 ** t.astype(jnp.float32)
         bc2 = 1 - b2 ** t.astype(jnp.float32)
         new_params = jax.tree_util.tree_map(
-            lambda p, m_, v_: p - lr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.epsilon),
+            lambda p, m_, v_: p - lr * (up(m_) / bc1)
+            / (jnp.sqrt(up(v_) / bc2) + self.epsilon),
             params, m, v)
         return new_params, {**state, "m": m, "v": v, "evalCounter": t}
 
@@ -347,9 +369,10 @@ class AdamW(Adam):
     def __init__(self, learningrate: float = 1e-3,
                  learningrate_decay: float = 0.0,
                  beta1: float = 0.9, beta2: float = 0.999,
-                 epsilon: float = 1e-8, weightdecay: float = 0.01):
+                 epsilon: float = 1e-8, weightdecay: float = 0.01,
+                 state_dtype=None):
         super().__init__(learningrate, learningrate_decay, beta1, beta2,
-                         epsilon, weightdecay=0.0)
+                         epsilon, weightdecay=0.0, state_dtype=state_dtype)
         self.decoupled_decay = weightdecay
 
     def get_hyper_parameter(self):

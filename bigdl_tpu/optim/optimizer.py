@@ -251,15 +251,34 @@ class Optimizer:
         elementwise tail (BN normalize, ReLU) in the backward instead of
         materializing those activation copies to HBM.
 
+        ``"block"``: per-transformer-block checkpointing — every
+        ``TransformerEncoder`` in the model recomputes inside each block
+        during the backward, keeping only block-boundary activations. THE
+        policy for billion-param LMs (full remat saves nothing there: one
+        outer checkpoint re-materialises all intermediates in its replay).
+
         Off by default (compute-bound models should keep activations)."""
+        from bigdl_tpu.nn.attention import TransformerEncoder
+        encs = [m for m in self.model.modules()
+                if isinstance(m, TransformerEncoder)]
+        for enc in encs:  # reset; "block" re-enables below
+            enc.remat_blocks = False
         if isinstance(enabled, str):
             if enabled == "full":  # alias for True (matches the bench lever)
                 self._remat = True
             elif enabled == "conv":
                 self._remat = enabled
+            elif enabled == "block":
+                if not encs:
+                    raise ValueError("remat='block' needs a model with "
+                                     "TransformerEncoder blocks")
+                for enc in encs:
+                    enc.remat_blocks = True
+                self._remat = False  # per-block checkpoints, no outer wrap
             else:
                 raise ValueError(f"unknown remat policy {enabled!r}; "
-                                 "expected True/False, 'full' or 'conv'")
+                                 "expected True/False, 'full', 'conv' or "
+                                 "'block'")
         else:
             self._remat = bool(enabled)
         return self

@@ -47,18 +47,26 @@ COLUMN, ROW, REPLICATE = "column", "row", "replicate"
 
 
 def enable_sequence_parallel(model, mesh, axis: str = TENSOR_AXIS,
-                             seq_dim: int = 1) -> int:
+                             seq_dim: int = 1, batch_axis: str = "data",
+                             batch_dim: int = 0) -> int:
     """Tag every ``TransformerEncoderLayer`` under ``model`` to constrain
     its residual stream seq-sharded over ``axis``. Returns the number of
     blocks tagged. Requires seq_len % mesh.shape[axis] == 0 at call sites
-    (GSPMD would otherwise pad unevenly)."""
+    (GSPMD would otherwise pad unevenly).
+
+    The batch dim keeps its data-parallel sharding (``batch_axis``, when
+    that axis exists in the mesh): constraining it to None would FORCE
+    batch replication at every region boundary, fighting the upstream dp
+    sharding — measured as XLA "involuntary full rematerialization"
+    (replicate-then-reshard) on every block entry in the dp x tp dryrun."""
     from bigdl_tpu import nn
     count = 0
+    batch = batch_axis if batch_axis in mesh.shape else None
     stack = [model]
     while stack:
         m = stack.pop()
         if isinstance(m, nn.TransformerEncoderLayer):
-            m._sp = (mesh, axis, seq_dim)
+            m._sp = (mesh, axis, seq_dim, batch, batch_dim)
             count += 1
         stack.extend(m._modules.values())
     return count
@@ -71,9 +79,10 @@ def sp_constrain(x, sp):
         return x
     import jax
     from jax.sharding import NamedSharding
-    mesh, axis, seq_dim = sp
+    mesh, axis, seq_dim, batch, batch_dim = sp
     spec = [None] * x.ndim
     spec[seq_dim] = axis
+    spec[batch_dim] = batch
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P(*spec)))
 

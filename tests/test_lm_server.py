@@ -1,0 +1,166 @@
+"""Batched LM serving (``models/lm_server.py``) — the reference's serving
+quadrant (``example/udfpredictor/``, ``ml/DLClassifier.scala:35``) replayed
+for the LM: batched inference behind a submit/transport boundary, verified
+against direct ``generate`` calls."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.models import transformer
+from bigdl_tpu.models.generation import generate
+from bigdl_tpu.models.lm_server import LMServer, make_http_server
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from bigdl_tpu.utils.rng import manual_seed
+    manual_seed(7)
+    return transformer.build_lm(32, 16, 2, 32, num_layers=1, max_len=64)
+
+
+def _direct(lm, rows, max_new, eos_id=None):
+    out = np.asarray(generate(lm, np.asarray(rows, np.float32), max_new,
+                              greedy=True, eos_id=eos_id)).astype(int)
+    return [r[len(rows[0]):].tolist() for r in out]
+
+
+class TestLMServer:
+    def test_single_request_matches_direct_generate(self, lm):
+        srv = LMServer(lm, greedy=True, max_new_tokens=8)
+        try:
+            got = srv.submit([3, 5, 7])
+            want = _direct(lm, [[3, 5, 7]], 8)[0]
+            assert got == want
+        finally:
+            srv.close()
+
+    def test_concurrent_same_length_requests_batch_together(self, lm):
+        srv = LMServer(lm, greedy=True, max_new_tokens=6,
+                       batch_timeout_ms=200, max_batch=4)
+        try:
+            prompts = [[3, 5, 7], [1, 2, 3], [9, 9, 1], [4, 4, 4]]
+            results = [None] * 4
+
+            def call(i):
+                results[i] = srv.submit(prompts[i], timeout=60)
+
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            want = _direct(lm, prompts, 6)
+            assert results == want
+            # all four rode one dispatch (the 200ms window gathered them)
+            assert srv.batches_served == 1
+        finally:
+            srv.close()
+
+    def test_mixed_lengths_split_into_length_groups(self, lm):
+        srv = LMServer(lm, greedy=True, max_new_tokens=4,
+                       batch_timeout_ms=100, max_batch=4)
+        try:
+            results = {}
+
+            def call(name, ids):
+                results[name] = srv.submit(ids, timeout=60)
+
+            threads = [
+                threading.Thread(target=call, args=("a", [3, 5, 7])),
+                threading.Thread(target=call, args=("b", [1, 2, 3, 4, 5])),
+                threading.Thread(target=call, args=("c", [9, 1, 2])),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert results["a"] == _direct(lm, [[3, 5, 7]], 4)[0]
+            assert results["b"] == _direct(lm, [[1, 2, 3, 4, 5]], 4)[0]
+            assert results["c"] == _direct(lm, [[9, 1, 2]], 4)[0]
+            assert srv.batches_served == 2  # length-3 group + length-5 group
+        finally:
+            srv.close()
+
+    def test_eos_freezes_and_strips_pad_tail(self, lm):
+        # find the greedy next token, declare IT the eos: continuation
+        # must stop right there, pad tail stripped
+        nxt = _direct(lm, [[3, 5, 7]], 1)[0][0]
+        srv = LMServer(lm, greedy=True, max_new_tokens=6, eos_id=nxt)
+        try:
+            got = srv.submit([3, 5, 7])
+            assert got == [nxt]
+        finally:
+            srv.close()
+
+    def test_per_request_budget_trims(self, lm):
+        srv = LMServer(lm, greedy=True, max_new_tokens=8)
+        try:
+            got = srv.submit([3, 5, 7], max_new_tokens=3)
+            assert got == _direct(lm, [[3, 5, 7]], 8)[0][:3]
+        finally:
+            srv.close()
+
+    def test_rejects_empty_prompt_and_oversize_budget(self, lm):
+        srv = LMServer(lm, greedy=True, max_new_tokens=4)
+        try:
+            with pytest.raises(ValueError, match="empty"):
+                srv.submit([])
+            with pytest.raises(ValueError, match="exceeds"):
+                srv.submit([1], max_new_tokens=99)
+        finally:
+            srv.close()
+
+    def test_int8_quantized_model_serves(self, lm):
+        from bigdl_tpu import nn
+        q = nn.quantize_model(lm)
+        srv = LMServer(q, greedy=True, max_new_tokens=4)
+        try:
+            got = srv.submit([3, 5, 7])
+            assert len(got) == 4 and all(1 <= t <= 32 for t in got)
+        finally:
+            srv.close()
+
+
+class TestHTTPRim:
+    def test_http_generate_and_health(self, lm):
+        srv = LMServer(lm, greedy=True, max_new_tokens=5)
+        httpd = make_http_server(srv, "127.0.0.1", 0)  # ephemeral port
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"prompt": [3, 5, 7]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                body = json.loads(resp.read())
+            assert body["ids"] == _direct(lm, [[3, 5, 7]], 5)[0]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=10) as resp:
+                health = json.loads(resp.read())
+            assert health["ok"] and health["batches_served"] >= 1
+        finally:
+            httpd.shutdown()
+            srv.close()
+
+    def test_http_bad_request(self, lm):
+        srv = LMServer(lm, greedy=True, max_new_tokens=5)
+        httpd = make_http_server(srv, "127.0.0.1", 0)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"text": "no tokenizer"}).encode())
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+        finally:
+            httpd.shutdown()
+            srv.close()
