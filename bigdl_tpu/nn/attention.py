@@ -147,11 +147,19 @@ class MultiHeadAttention(Module):
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
+        # applied to the normalised attention PROBABILITIES in training
+        # (torch nn.MultiheadAttention semantics; round-3 misplaced it on
+        # the output projection). Excluded from the flash/blockwise paths
+        # — they never materialise normalised probabilities — so training
+        # with dropout > 0 dispatches the plain XLA core.
         self.dropout_p = dropout
+        if dropout and seq_axis is not None:
+            raise ValueError("attention dropout does not compose with "
+                             "context-parallel attention (the ring/Ulysses "
+                             "cores use online softmax); train with "
+                             "dropout=0 or drop seq_axis")
         self.with_bias = with_bias
         self.causal = causal
-        from bigdl_tpu.nn.regularization import Dropout
-        self.dropout = Dropout(dropout)
         # 0 = plain XLA attention; >0 = blockwise (flash) with that block.
         self.block_size = block_size
         e_kv = self.num_kv_heads * self.head_dim
@@ -324,7 +332,7 @@ class MultiHeadAttention(Module):
                          self.out_proj_weight.T)
         if self.with_bias:
             out = out + self.out_proj_bias
-        return self.dropout.forward(out)
+        return out
 
     def _attend(self, q, k, v, mask):
         from bigdl_tpu.ops import attention_core, flash_attention
@@ -339,14 +347,18 @@ class MultiHeadAttention(Module):
             return context.ulysses_attention(q, k, v,
                                              axis_name=self.seq_axis,
                                              causal=self.causal)
-        if flash_attention.use_flash(q, mask):
-            return flash_attention.flash_attention(q, k, v, causal=self.causal)
-        if self.block_size:
-            return attention_core.blockwise_attention(
-                q, k, v, mask=mask, causal=self.causal,
-                block_size=self.block_size)
+        drop = self.dropout_p if (self.training and self.dropout_p) else 0.0
+        if not drop:  # prob-dropout needs the plain core (see __init__)
+            if flash_attention.use_flash(q, mask):
+                return flash_attention.flash_attention(q, k, v,
+                                                       causal=self.causal)
+            if self.block_size:
+                return attention_core.blockwise_attention(
+                    q, k, v, mask=mask, causal=self.causal,
+                    block_size=self.block_size)
         return attention_core.dot_product_attention(
-            q, k, v, mask=mask, causal=self.causal)
+            q, k, v, mask=mask, causal=self.causal, dropout_p=drop,
+            dropout_key=self.rng_key() if drop else None)
 
     def __repr__(self):
         return (f"MultiHeadAttention({self.embed_dim}, heads={self.num_heads}"
@@ -456,8 +468,14 @@ class TransformerEncoderLayer(Module):
         self.moe_experts = moe_experts
         # bias=False drops EVERY affine bias in the block (attention in/out
         # projections and the FFN linears) — the Llama-family convention.
+        # Context-parallel attention gets NO prob-dropout (its ring/Ulysses
+        # cores use online softmax and never materialise probabilities);
+        # the block's residual/FFN dropout still applies, so
+        # build_lm(dropout=..., seq_axis=...) stays constructible.
         self.self_attn = MultiHeadAttention(embed_dim, num_heads,
-                                            dropout=dropout, causal=causal,
+                                            dropout=(0.0 if seq_axis
+                                                     else dropout),
+                                            causal=causal,
                                             block_size=block_size,
                                             seq_axis=seq_axis,
                                             seq_mode=seq_mode,

@@ -44,11 +44,19 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           mask: Optional[jax.Array] = None,
                           bias: Optional[jax.Array] = None,
                           causal: bool = False,
-                          scale: Optional[float] = None) -> jax.Array:
+                          scale: Optional[float] = None,
+                          dropout_p: float = 0.0,
+                          dropout_key: Optional[jax.Array] = None) -> jax.Array:
     """softmax(q k^T * scale + bias) v, shapes (B, S, N, D).
 
     ``mask``: broadcastable to (B, N, Sq, Sk), True where attention is
-    allowed. ``causal`` adds the lower-triangular mask.
+    allowed. ``causal`` adds the lower-triangular mask. ``dropout_p`` with
+    a ``dropout_key`` applies inverted-scale dropout to the NORMALISED
+    attention probabilities (torch ``nn.MultiheadAttention`` semantics —
+    unbiased: E[output] equals the no-dropout output). Only this core
+    takes it: the blockwise/flash paths never see normalised probabilities
+    (online softmax normalises at the end), so the dispatch gate excludes
+    them under attention dropout.
     """
     *_, sq, n, d = q.shape
     sk = k.shape[1]
@@ -71,6 +79,12 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     dead = jnp.max(logits, axis=-1, keepdims=True) <= jnp.finfo(logits.dtype).min / 2
     weights = jax.nn.softmax(logits, axis=-1)
     weights = jnp.where(dead, 0.0, weights)
+    if dropout_p > 0.0:
+        if dropout_key is None:
+            raise ValueError("dropout_p > 0 needs a dropout_key")
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
+                                    weights.shape)
+        weights = jnp.where(keep, weights, 0.0) / (1.0 - dropout_p)
     return jnp.einsum("bnqk,bknd->bqnd", weights.astype(q.dtype), v)
 
 

@@ -372,3 +372,225 @@ def gpipe_loss_fn(stack: PipelineStack, criterion, mesh,
         in_specs=(p_specs, P(), x_spec, x_spec),
         out_specs=P(),
         check_vma=False)
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous stage-list pipelining (round 4)
+# ---------------------------------------------------------------------------
+
+class StagePipeline:
+    """GPipe over a LIST of arbitrary, shape-heterogeneous stages — the API
+    that pipelines a REAL model end-to-end: ``[embedding+blocks, blocks,
+    blocks+norm+head]`` for an LM, or ResNet-50's four stages (each with a
+    different activation shape).
+
+    ``PipelineStack`` requires homogeneous blocks because its schedule
+    scans one block body over a stacked layer axis and ships one
+    fixed-shape activation around the ring. Heterogeneity breaks both, so
+    this class restores the two invariants XLA needs by construction:
+
+    - per-device COMPUTE: each device runs its own stage through
+      ``lax.switch`` on the stage index — one compiled program containing
+      every stage body, each device executing only its own at runtime
+      (SPMD programs must be identical; the switch makes them so);
+    - fixed-shape TRANSPORT: per-stage parameters ravel into one
+      (P, max_param_len) array (sharded over ``pipe`` — each device holds
+      only its own stage's weights, preserving pipeline memory scaling),
+      and inter-stage activations travel as a flat conduit padded to the
+      LARGEST boundary activation, unpacked per stage to its static shape
+      inside the switch branch.
+
+    Stage modules may carry CONSTANT buffers (a PositionalEncoding table)
+    — they ride along as compile-time constants — but not step-MUTABLE
+    ones (BatchNorm running stats, decode caches): bubble steps would
+    corrupt them, so mutation is detected at construction (one real
+    forward per stage on the sample microbatch, before/after comparison)
+    and rejected; use norm-free/LayerNorm stages, or the homogeneous
+    ``PipelineStack`` which threads buffers. Shapes are discovered on the
+    same probe forward, so stages may change the activation shape
+    arbitrarily (downsampling convs, vocab heads). ``jax.grad`` through
+    the schedule is the backward pipeline, exactly as for
+    ``PipelineStack``.
+    """
+
+    def __init__(self, stages, sample_microbatch):
+        if len(stages) < 2:
+            raise ValueError("need at least 2 stages to pipeline")
+        self.stages = list(stages)
+        p = len(stages)
+        from jax.flatten_util import ravel_pytree
+        flats, self._unravels, lens = [], [], []
+        for st in stages:
+            flat, unravel = ravel_pytree(st.parameter_tree())
+            flats.append(flat)
+            self._unravels.append(unravel)
+            lens.append(flat.shape[0])
+        self._param_lens = lens
+        self.max_param_len = max(lens)
+        self._stacked = jnp.stack([
+            jnp.pad(f, (0, self.max_param_len - f.shape[0])) for f in flats])
+
+        # probe forward per stage: discovers boundary shapes AND proves the
+        # stage's buffers are step-constant (mutable state cannot survive
+        # the schedule's bubble steps)
+        x = jnp.asarray(sample_microbatch)
+        self._in_shapes, self._in_dtypes, self._const_bufs = [], [], []
+        for i, st in enumerate(stages):
+            self._in_shapes.append(tuple(x.shape))
+            self._in_dtypes.append(x.dtype)
+            bufs = st.buffer_tree()
+            self._const_bufs.append(bufs)
+            x, new_bufs = functional_apply(st, st.parameter_tree(), bufs, x,
+                                           training=True)
+            changed = [
+                k for k, (a, b) in enumerate(zip(
+                    jax.tree_util.tree_leaves(bufs),
+                    jax.tree_util.tree_leaves(new_bufs)))
+                if not np.allclose(np.asarray(a), np.asarray(b))]
+            if changed:
+                raise ValueError(
+                    f"stage {i} mutates buffers during forward (BatchNorm "
+                    "running stats?); StagePipeline needs step-constant "
+                    "stages — use LayerNorm/GroupNorm, or the homogeneous "
+                    "PipelineStack which threads buffers")
+        self.out_shape, self.out_dtype = tuple(x.shape), x.dtype
+        # the conduit carries stage-boundary activations AND stage 0's
+        # fresh feed (same buffer via the is_first select), so size to the
+        # largest of all of them
+        sizes = [int(np.prod(s)) for s in self._in_shapes]
+        sizes.append(int(np.prod(self.out_shape)))
+        self.conduit_len = max(sizes)
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.stages)
+
+    def parameter_tree(self):
+        """(P, max_param_len) — shard row-wise over the ``pipe`` axis."""
+        return self._stacked
+
+    def spec(self, axis: str = PIPELINE_AXIS):
+        from jax.sharding import PartitionSpec as P
+        return P(axis, None)
+
+    def unstack_parameter_trees(self, stacked):
+        """Inverse of the stacked layout: per-stage pytrees (for moving
+        trained weights back into the stage modules / checkpoints)."""
+        return [self._unravels[i](stacked[i, :self._param_lens[i]])
+                for i in range(len(self.stages))]
+
+    def sequential_apply(self, stacked, x, training: bool = True):
+        """Reference forward (no pipelining): the exact math the schedule
+        must reproduce; used by differential tests and single-device runs."""
+        h = x
+        for i, st in enumerate(self.stages):
+            params = self._unravels[i](stacked[i, :self._param_lens[i]])
+            h, _ = functional_apply(st, params, self._const_bufs[i], h,
+                                    training=training)
+        return h
+
+    def _branch(self, i, training: bool):
+        """Stage i body: flat conduit in -> flat conduit out."""
+        st = self.stages[i]
+        in_shape, in_dtype = self._in_shapes[i], self._in_dtypes[i]
+        n_in = int(np.prod(in_shape))
+        bufs = self._const_bufs[i]  # step-constant, proven at __init__
+
+        def body(flat_params, conduit):
+            params = self._unravels[i](flat_params[:self._param_lens[i]])
+            h = conduit[:n_in].reshape(in_shape).astype(in_dtype)
+            out, _ = functional_apply(st, params, bufs, h,
+                                      training=training)
+            flat = out.astype(jnp.float32).reshape(-1)
+            return jnp.pad(flat, (0, self.conduit_len - flat.shape[0]))
+
+        return body
+
+    def pipeline_apply(self, local_stacked, x, n_micro: int,
+                       axis_name: str = PIPELINE_AXIS,
+                       remat: bool = False, training: bool = True):
+        """GPipe schedule INSIDE shard_map: microbatches enter stage 0,
+        march stage-to-stage via ``lax.ppermute`` in the flat conduit, and
+        the last stage's outputs are psum-replicated (transpose: the
+        output cotangent re-enters the backward ring at the last stage)."""
+        p = lax.axis_size(axis_name)
+        assert p == len(self.stages), (
+            f"mesh '{axis_name}' axis ({p}) must equal the stage count "
+            f"({len(self.stages)})")
+        idx = lax.axis_index(axis_name)
+        b = x.shape[0]
+        assert b % n_micro == 0, (b, n_micro)
+        mb = self._in_shapes[0][0]
+        assert b // n_micro == mb, (
+            f"microbatch {b}//{n_micro}={b // n_micro} != sample_microbatch "
+            f"batch {mb} used at construction (conduit sizes are static)")
+        mbs = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+        n_in0 = int(np.prod(self._in_shapes[0]))
+        out_len = int(np.prod(self.out_shape))
+
+        branches = [self._branch(i, training) for i in range(p)]
+        if remat:
+            branches = [jax.checkpoint(fn) for fn in branches]
+
+        def compute(flat_params, conduit):
+            return lax.switch(idx, branches, flat_params[0], conduit)
+
+        perm = [(i, (i + 1) % p) for i in range(p)]
+        state0 = lax.pcast(jnp.zeros((self.conduit_len,), jnp.float32),
+                           (axis_name,), to="varying")
+        out_buf0 = lax.pcast(
+            jnp.zeros((n_micro, out_len), jnp.float32),
+            (axis_name,), to="varying")
+        is_first = (idx == 0)
+        is_last = (idx == p - 1)
+
+        def step(carry, t):
+            state, out_buf = carry
+            feed = lax.dynamic_index_in_dim(
+                mbs, jnp.minimum(t, n_micro - 1), 0,
+                keepdims=False).astype(jnp.float32).reshape(-1)
+            feed = jnp.pad(feed, (0, self.conduit_len - n_in0))
+            inp = jnp.where(is_first & (t < n_micro), feed, state)
+            out = compute(local_stacked, inp)
+            w = t - (p - 1)
+            upd = lax.dynamic_update_index_in_dim(
+                out_buf, out[:out_len], jnp.maximum(w, 0), 0)
+            out_buf = jnp.where(is_last & (w >= 0), upd, out_buf)
+            state = lax.ppermute(out, axis_name, perm)
+            return (state, out_buf), None
+
+        (_, out_buf), _ = lax.scan(
+            step, (state0, out_buf0),
+            jnp.arange(schedule_length(n_micro, p)))
+        out_buf = lax.psum(out_buf, axis_name)
+        mb = b // n_micro
+        return out_buf.reshape(n_micro * mb, *self.out_shape[1:]) \
+            .astype(self.out_dtype)
+
+
+def stage_pipeline_loss_fn(pipe: StagePipeline, criterion, mesh,
+                           n_micro: int, axis_name: str = PIPELINE_AXIS,
+                           remat: bool = False,
+                           data_axis: Optional[str] = None):
+    """(stacked_params (P, L), x, labels) -> scalar loss, jittable.
+
+    The heterogeneous counterpart of ``gpipe_loss_fn``: pass
+    ``pipe.parameter_tree()`` placed with ``pipe.spec()`` so each device
+    holds only its stage's weights. ``data_axis`` composes dp x pp the
+    same way (independent pipelines per data group, pmean'd loss)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    x_spec = P(data_axis) if data_axis else P()
+
+    def local_fn(stacked, x, labels):
+        feats = pipe.pipeline_apply(stacked, x, n_micro, axis_name,
+                                    remat=remat)
+        loss = criterion.apply(feats, labels).astype(jnp.float32)
+        if data_axis:
+            loss = lax.pmean(loss, data_axis)
+        return loss
+
+    return shard_map(local_fn, mesh=mesh,
+                     in_specs=(pipe.spec(axis_name), x_spec, x_spec),
+                     out_specs=P(), check_vma=False)

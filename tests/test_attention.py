@@ -387,3 +387,77 @@ class TestMoETransformerLayer:
                                  num_layers=1, max_len=16, moe_experts=4)
         out = m.forward(jnp.ones((2, 8)))
         assert out.shape == (2, 8, 32)
+
+
+class TestAttentionProbDropout:
+    """Round-4 fix: dropout applies to the normalised attention
+    PROBABILITIES (torch nn.MultiheadAttention semantics), not the output
+    projection. Statistical oracle: inverted-scale dropout is unbiased, so
+    the MEAN of many training forwards must converge to the eval forward,
+    while individual draws must differ."""
+
+    def _mha(self, p):
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        from bigdl_tpu.utils.rng import manual_seed
+        manual_seed(11)
+        return MultiHeadAttention(16, 4, dropout=p, causal=True)
+
+    def test_mean_converges_to_eval_output(self):
+        import jax
+        import numpy as np
+        from bigdl_tpu.nn.module import functional_apply
+        m = self._mha(0.5)
+        x = np.random.default_rng(0).normal(0, 1, (2, 6, 16)).astype("f4")
+        m.evaluate_mode()
+        ref = np.asarray(m.forward(x))
+        m.training_mode()
+        params, buffers = m.functional_state()
+        outs = []
+        for i in range(400):
+            out, _ = functional_apply(m, params, buffers, x, training=True,
+                                      rng=jax.random.PRNGKey(i))
+            outs.append(np.asarray(out))
+        outs = np.stack(outs)
+        # draws genuinely differ (dropout active)...
+        assert np.abs(outs[0] - outs[1]).max() > 1e-4
+        # ...and are unbiased around the eval output: SE ~ sigma/sqrt(400)
+        err = np.abs(outs.mean(0) - ref)
+        tol = 4 * outs.std(0) / np.sqrt(400) + 1e-4
+        assert (err < tol).mean() > 0.98, (
+            f"mean-vs-eval deviation beyond 4 SE for "
+            f"{(err >= tol).mean():.1%} of outputs")
+
+    def test_eval_mode_is_deterministic_and_dropout_free(self):
+        import numpy as np
+        m = self._mha(0.5)
+        x = np.random.default_rng(1).normal(0, 1, (1, 5, 16)).astype("f4")
+        m.evaluate_mode()
+        a, b = np.asarray(m.forward(x)), np.asarray(m.forward(x))
+        np.testing.assert_array_equal(a, b)
+
+    def test_dropout_rejects_context_parallel(self):
+        import pytest
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        with pytest.raises(ValueError, match="context-parallel"):
+            MultiHeadAttention(16, 4, dropout=0.1, seq_axis="seq")
+
+    def test_grads_flow_through_dropout(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from bigdl_tpu.nn.module import functional_apply
+        m = self._mha(0.3)
+        m.training_mode()
+        params, buffers = m.functional_state()
+        x = jnp.asarray(np.random.default_rng(2).normal(0, 1, (1, 4, 16)),
+                        jnp.float32)
+
+        def loss(p):
+            out, _ = functional_apply(m, p, buffers, x, training=True,
+                                      rng=jax.random.PRNGKey(0))
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss)(params)
+        total = sum(float(jnp.abs(leaf).sum())
+                    for leaf in jax.tree_util.tree_leaves(g))
+        assert np.isfinite(total) and total > 0
