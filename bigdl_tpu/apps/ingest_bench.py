@@ -1,0 +1,158 @@
+"""Sustained shard-ingest benchmark — the ImageNet-scale host data path
+(reference ``SeqFileFolder`` streaming, ``dataset/DataSet.scala:495-558`` +
+``MTLabeledBGRImgToBatch``), measured stage by stage so the binding
+bottleneck gets a NAME:
+
+    # one-time: synthetic raw-BGR corpus, shard files on disk
+    python -m bigdl_tpu.apps.ingest_bench generate -o /tmp/shards -n 4096
+    # raw shard read (disk + CRC framing walk), no decode
+    python -m bigdl_tpu.apps.ingest_bench read -s /tmp/shards
+    # + decode/normalize/collate through the MT pipeline
+    python -m bigdl_tpu.apps.ingest_bench decode -s /tmp/shards -w 4
+    # end-to-end: streaming shards feeding the real ResNet-50 train loop
+    python -m bigdl_tpu.apps.ingest_bench train -s /tmp/shards
+
+Each mode prints one JSON line with records/s, so the host path can be
+compared against the device-cached consumption ceiling (PERF.md: 2561
+img/s for ResNet-50 b=256 on one v5e chip).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+IMG_BYTES = 224 * 224 * 3
+
+
+def _gen(args) -> None:
+    from bigdl_tpu.dataset.shards import ShardWriter
+    rng = np.random.RandomState(7)
+    t0 = time.time()
+    with ShardWriter(f"{args.out}/part", records_per_shard=args.perShard) as w:
+        for i in range(args.records):
+            w.write(float(i % 1000 + 1),
+                    rng.randint(0, 256, IMG_BYTES, np.uint8).tobytes())
+    print(json.dumps({"mode": "generate", "records": args.records,
+                      "bytes": args.records * IMG_BYTES,
+                      "wall_s": round(time.time() - t0, 1)}))
+
+
+def _pipeline(args):
+    """Full host path: stream -> MT decode/normalize -> collate -> prefetch."""
+    from bigdl_tpu.dataset.base import Prefetch
+    from bigdl_tpu.dataset.image import (BGRImgNormalizer, BytesToBGRImg,
+                                         MTLabeledBGRImgToBatch)
+    from bigdl_tpu.dataset.shards import ShardFolder
+    mt = MTLabeledBGRImgToBatch(
+        224, 224, args.batchSize,
+        transformer=BytesToBGRImg(224, 224) >> BGRImgNormalizer(127.5, 73.0),
+        workers=args.workers)
+    return ShardFolder.stream(args.shards) >> mt >> Prefetch(args.prefetch)
+
+
+def _cycle(make_iter):
+    """Endless stream over finite per-epoch iterators (training re-reads
+    the shard folder each epoch; empty datasets terminate)."""
+    while True:
+        n = 0
+        for item in make_iter():
+            n += 1
+            yield item
+        if n == 0:
+            return
+
+
+def _measure_iter(make_iter, record_weight, warm: int, budget_s: float):
+    """records/s over the steady state (after ``warm`` items), cycling
+    epochs until the time budget is spent."""
+    n = 0
+    t0 = t_warm = time.time()
+    for _ in _cycle(make_iter):
+        n += 1
+        if n == warm:
+            t_warm = time.time()
+        if time.time() - t0 > budget_s and n > warm:
+            break
+    steady = (n - warm) * record_weight
+    dt = time.time() - t_warm
+    return steady / dt if dt > 0 and steady > 0 else 0.0
+
+
+def _read(args) -> None:
+    from bigdl_tpu.dataset.shards import ShardFolder
+    ds = ShardFolder.stream(args.shards)
+    warm = min(256, max(1, ds.size() // 4))
+    rate = _measure_iter(lambda: ds.data(train=True), 1, warm=warm,
+                         budget_s=args.budget)
+    print(json.dumps({"mode": "read", "records_per_sec": round(rate, 1),
+                      "gbytes_per_sec": round(rate * IMG_BYTES / 1e9, 3)}))
+
+
+def _decode(args) -> None:
+    ds = _pipeline(args)
+    rate = _measure_iter(lambda: ds.data(train=True), args.batchSize,
+                         warm=2, budget_s=args.budget)
+    print(json.dumps({"mode": "decode", "workers": args.workers,
+                      "records_per_sec": round(rate, 1)}))
+
+
+def _train(args) -> None:
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import resnet
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.ops.precision import DtypePolicy
+    from bigdl_tpu.utils.logger_filter import redirect_logs
+    redirect_logs()
+    ds = _pipeline(args)
+    model = resnet.build(1000, depth=50)
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion())
+    opt.set_optim_method(SGD(learningrate=0.01))
+    opt.set_precision(DtypePolicy.bf16())
+    opt.set_end_when(Trigger.max_iteration(args.iterations))
+
+    rates = []
+
+    class _Rec:
+        def add_scalar(self, tag, value, step):
+            if tag == "Throughput":
+                rates.append(float(value))
+
+        def get_summary_trigger(self, name):
+            return None
+
+    opt.set_train_summary(_Rec())
+    t0 = time.time()
+    opt.optimize()
+    steady = rates[args.warmup:]
+    print(json.dumps({
+        "mode": "train", "iterations": args.iterations,
+        "records_per_sec": round(float(np.mean(steady)), 1) if steady else 0,
+        "wall_s": round(time.time() - t0, 1)}))
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="bigdl_tpu.apps.ingest_bench")
+    ap.add_argument("mode", choices=("generate", "read", "decode", "train"))
+    ap.add_argument("--out", "-o", default="/tmp/bigdl_shards")
+    ap.add_argument("--shards", "-s", default="/tmp/bigdl_shards")
+    ap.add_argument("--records", "-n", type=int, default=4096)
+    ap.add_argument("--perShard", type=int, default=512)
+    ap.add_argument("--batchSize", "-b", type=int, default=256)
+    ap.add_argument("--workers", "-w", type=int, default=4)
+    ap.add_argument("--prefetch", type=int, default=2)
+    ap.add_argument("--budget", type=float, default=60.0,
+                    help="measurement budget (seconds) for read/decode")
+    ap.add_argument("--iterations", "-i", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args(argv)
+    {"generate": _gen, "read": _read, "decode": _decode,
+     "train": _train}[args.mode](args)
+
+
+if __name__ == "__main__":
+    main()

@@ -427,8 +427,13 @@ class StagePipeline:
             lens.append(flat.shape[0])
         self._param_lens = lens
         self.max_param_len = max(lens)
-        self._stacked = jnp.stack([
-            jnp.pad(f, (0, self.max_param_len - f.shape[0])) for f in flats])
+        # HOST-side stack (numpy): the full (P, max_len) array must never
+        # materialise on one device — pipelining exists precisely for
+        # models that exceed one chip's HBM. The caller device_puts it
+        # with pipe.spec(), so each device only ever receives its row.
+        self._stacked = np.stack([
+            np.pad(np.asarray(f), (0, self.max_param_len - f.shape[0]))
+            for f in flats])
 
         # probe forward per stage: discovers boundary shapes AND proves the
         # stage's buffers are step-constant (mutable state cannot survive

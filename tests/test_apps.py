@@ -310,3 +310,39 @@ class TestPerfHarness:
         rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert rec["model"] == "transformer"
         assert rec["records_per_sec_incl_compile"] > 0
+
+    def test_perf_moe_flag_builds_moe_model(self, capsys):
+        perf.main(["--model", "transformer", "-b", "2", "-i", "1",
+                   "--warmup", "1", "--precision", "fp32",
+                   "--moeExperts", "2"])
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rec["records_per_sec_incl_compile"] > 0
+
+    def test_perf_adamw_remat_block(self, capsys):
+        perf.main(["--model", "transformer", "-b", "2", "-i", "1",
+                   "--warmup", "1", "--precision", "fp32",
+                   "--optim", "adamw", "--optStateDtype", "bf16",
+                   "--remat", "block"])
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rec["records_per_sec_incl_compile"] > 0
+
+
+class TestIngestBench:
+    """Shard-ingest benchmark app (apps/ingest_bench): generate -> read ->
+    decode stages produce sane JSON on a tiny corpus (the on-chip train
+    stage and full-size corpus are exercised by the PERF.md runs)."""
+
+    def test_generate_read_decode(self, tmp_path, capsys):
+        from bigdl_tpu.apps import ingest_bench
+        out = str(tmp_path / "shards")
+        ingest_bench.main(["generate", "-o", out, "-n", "64",
+                           "--perShard", "32"])
+        gen = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert gen["records"] == 64
+        ingest_bench.main(["read", "-s", out, "--budget", "5"])
+        rd = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rd["records_per_sec"] > 0
+        ingest_bench.main(["decode", "-s", out, "-b", "8", "-w", "2",
+                           "--budget", "5"])
+        dec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert dec["records_per_sec"] > 0
