@@ -56,16 +56,38 @@ def filter_top_p(logprobs: jax.Array, p: float) -> jax.Array:
 def sample_token(logprobs: jax.Array, key: Optional[jax.Array], *,
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 0.0, greedy: bool = False) -> jax.Array:
-    """One sampling step over (B, V) log-probs -> (B,) 1-based token ids."""
+    """One sampling step over (B, V) log-probs -> (B,) 1-based token ids.
+
+    With ``top_k > 0`` the whole tail runs FUSED on the (B, k) candidate
+    sliver: one ``top_k`` over V, then temperature/top-p/Gumbel-argmax on
+    k values — mathematically identical to filter+renormalise+categorical
+    (Gumbel-max trick), but it drops every other V-wide kernel from the
+    decode step. Measured on chip: top-k sampling cost fell from
+    +182 us/step to near-greedy (PERF.md round 4) — at B=1 the decode is
+    per-kernel-overhead-bound, so kernel COUNT is the lever."""
     if greedy:
         return jnp.argmax(logprobs, axis=-1).astype(jnp.int32) + 1
     lp = logprobs.astype(jnp.float32)
+    if top_k > 0 and top_k < lp.shape[-1]:
+        vals, idx = jax.lax.top_k(lp, top_k)          # (B, k) sorted desc
+        if temperature != 1.0:
+            vals = vals / max(float(temperature), 1e-6)
+        vals = jax.nn.log_softmax(vals, axis=-1)      # renormalised over k
+        if 0.0 < top_p < 1.0:
+            # nucleus within the (already sorted) candidates: keep entries
+            # while the mass BEFORE them is < p (top-1 always kept)
+            cum = jnp.cumsum(jnp.exp(vals), axis=-1)
+            keep = (cum - jnp.exp(vals)) < top_p
+            vals = jnp.where(keep, vals, -jnp.inf)
+        g = jax.random.gumbel(key, vals.shape)
+        choice = jnp.argmax(vals + g, axis=-1)        # Gumbel-max == sample
+        tok = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
+        return tok.astype(jnp.int32) + 1
     if temperature != 1.0:
         lp = lp / max(float(temperature), 1e-6)
-    lp = filter_top_k(lp, top_k)
-    # re-normalise after top-k so top_p trims the nucleus of the REMAINING
-    # distribution (standard composed semantics; filter_top_p requires
-    # normalised log-probs)
+    # re-normalise so top_p trims the nucleus of the REMAINING distribution
+    # (standard composed semantics; filter_top_p requires normalised
+    # log-probs)
     lp = filter_top_p(jax.nn.log_softmax(lp, axis=-1), top_p)
     return jax.random.categorical(key, lp, axis=-1).astype(jnp.int32) + 1
 
