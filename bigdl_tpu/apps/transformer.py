@@ -272,8 +272,14 @@ def generate_cmd(argv) -> None:
     ap.add_argument("--model", default=None,
                     help="saved model path (file_io); default: train a "
                     "fresh tiny LM on the synthetic grammar first")
+    ap.add_argument("--fromHF", default=None, metavar="DIR",
+                    help="load a HuggingFace checkpoint directory "
+                    "(config.json + safetensors/bin; GPT-2 or Llama "
+                    "family) instead of --model. Prompt ids are then "
+                    "HF 0-based ids.")
     ap.add_argument("--prompt", default="1,2,3",
-                    help="comma-separated 1-based token ids")
+                    help="comma-separated 1-based token ids "
+                    "(0-based with --fromHF)")
     ap.add_argument("--maxNewTokens", type=int, default=32)
     ap.add_argument("--temperature", type=float, default=1.0)
     ap.add_argument("--topK", type=int, default=0)
@@ -298,7 +304,20 @@ def generate_cmd(argv) -> None:
 
     from bigdl_tpu.models.generation import generate
 
-    if args.model:
+    hf_shift = 0
+    if args.fromHF and args.model:
+        raise SystemExit("pass --model or --fromHF, not both")
+    if args.fromHF and args.tokenizer:
+        raise SystemExit("--fromHF does not compose with --tokenizer (a "
+                         "framework BPE vocab against an HF checkpoint's "
+                         "vocab would decode garbage); pass raw HF ids")
+    if args.fromHF:
+        from bigdl_tpu.interop.hf import load_hf_checkpoint
+        model = load_hf_checkpoint(args.fromHF)
+        hf_shift = 1  # HF ids are 0-based; the framework's are 1-based
+        if args.eosId is not None:
+            args.eosId += hf_shift  # the CLI eos is an HF id too
+    elif args.model:
         model = file_io.load(args.model)
     else:
         print("no --model given: training a tiny LM on the synthetic "
@@ -314,7 +333,8 @@ def generate_cmd(argv) -> None:
         if args.eosId is None:
             args.eosId = tok.eos_id
     else:
-        ids = [float(t) for t in args.prompt.split(",") if t.strip()]
+        ids = [float(t) + hf_shift
+               for t in args.prompt.split(",") if t.strip()]
     if not ids:
         raise SystemExit("empty prompt: pass at least one token (text with "
                          "--tokenizer, else comma-separated 1-based ids); a "
@@ -330,6 +350,8 @@ def generate_cmd(argv) -> None:
                    min_new_tokens=args.minNewTokens,
                    key=jax.random.PRNGKey(args.seed))
     ids = np.asarray(out[0]).astype(int).tolist()  # one host transfer
+    if hf_shift:
+        ids = [i - hf_shift for i in ids]  # back to HF 0-based ids
     n0 = prompt.shape[1]
     if tok is not None:
         print("prompt:      ", repr(tok.decode(ids[:n0])))
@@ -348,6 +370,10 @@ def serve_cmd(argv) -> None:
     ap.add_argument("--model", default=None,
                     help="saved model path (file_io); default: train a "
                     "fresh tiny LM on the synthetic grammar first")
+    ap.add_argument("--fromHF", default=None, metavar="DIR",
+                    help="serve a HuggingFace checkpoint directory "
+                    "(GPT-2/Llama family); clients then speak 1-based "
+                    "framework ids (HF id + 1) unless --tokenizer is set")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--maxBatch", type=int, default=8,
@@ -371,7 +397,12 @@ def serve_cmd(argv) -> None:
 
     from bigdl_tpu.models.lm_server import LMServer, make_http_server
 
-    if args.model:
+    if args.fromHF and args.model:
+        raise SystemExit("pass --model or --fromHF, not both")
+    if args.fromHF:
+        from bigdl_tpu.interop.hf import load_hf_checkpoint
+        model = load_hf_checkpoint(args.fromHF)
+    elif args.model:
         model = file_io.load(args.model)
     else:
         print("no --model given: training a tiny LM on the synthetic "

@@ -170,12 +170,12 @@ def llama_lm_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
         # every attention score if ignored — refuse, don't corrupt
         raise ValueError(f"rope_scaling {scaling!r} is not supported yet "
                          "(plain rope_theta frequencies only)")
-    if config.get("sliding_window"):
-        raise ValueError("sliding-window attention (Mistral v0.1-style) is "
-                         "not mapped: imported models attend globally and "
-                         "would diverge beyond the window")
+    window = config.get("sliding_window")
     heads = int(config["num_attention_heads"])
     return dict(
+        # Mistral-style sliding window maps to banded causal attention
+        # (query i sees keys (i - window, i]); None = global
+        window=int(window) if window else None,
         vocab_size=int(config["vocab_size"]),
         embed_dim=int(config["hidden_size"]),
         num_heads=heads,
@@ -234,6 +234,169 @@ def load_llama(config: Dict[str, Any], state_dict: Dict[str, Any]) -> Module:
     # tied checkpoints carry no lm_head.weight; untied must have it
     strict = not kwargs["tie_embeddings"]
     return import_lm_state_dict(model, ours, strict=strict)
+
+
+# ------------------------------------------------------------------- export
+
+def export_gpt2_state_dict(model: Module) -> Dict[str, np.ndarray]:
+    """Inverse of ``gpt2_state_dict_to_lm``: a GPT-2-shaped ``build_lm``
+    model (pos="learned", tied embeddings, LayerNorm, biased) exported as
+    an HF ``GPT2LMHeadModel`` state_dict (``transformer.``-prefixed
+    Conv1D layout) — so models trained here load straight into
+    ``transformers``. The reference's interop is likewise bidirectional
+    (``utils/TorchFile.scala:67`` saves as well as loads)."""
+    from bigdl_tpu.interop.state_dict import export_lm_state_dict
+    ours = export_lm_state_dict(model)
+    if "pos_embedding.weight" not in ours:
+        raise ValueError("GPT-2 export needs build_lm(pos='learned') "
+                         "(a trained wpe table)")
+    if "lm_head.weight" in ours:
+        raise ValueError("GPT-2 export needs tie_embeddings=True "
+                         "(GPT-2 checkpoints carry no separate head)")
+    out: Dict[str, np.ndarray] = {
+        "transformer.wte.weight": ours["embedding.weight"],
+        "transformer.wpe.weight": ours["pos_embedding.weight"],
+        "transformer.ln_f.weight": ours["encoder.norm.weight"],
+        "transformer.ln_f.bias": ours["encoder.norm.bias"],
+        "lm_head.weight": ours["embedding.weight"],  # tied duplicate
+    }
+    n_layers = 1 + max(int(k.split(".")[2]) for k in ours
+                       if k.startswith("encoder.layers."))
+    for i in range(n_layers):
+        src, dst = f"encoder.layers.{i}", f"transformer.h.{i}"
+        out[f"{dst}.ln_1.weight"] = ours[f"{src}.norm1.weight"]
+        out[f"{dst}.ln_1.bias"] = ours[f"{src}.norm1.bias"]
+        out[f"{dst}.ln_2.weight"] = ours[f"{src}.norm2.weight"]
+        out[f"{dst}.ln_2.bias"] = ours[f"{src}.norm2.bias"]
+        out[f"{dst}.attn.c_attn.weight"] = \
+            ours[f"{src}.self_attn.in_proj_weight"].T.copy()
+        out[f"{dst}.attn.c_attn.bias"] = ours[f"{src}.self_attn.in_proj_bias"]
+        out[f"{dst}.attn.c_proj.weight"] = \
+            ours[f"{src}.self_attn.out_proj.weight"].T.copy()
+        out[f"{dst}.attn.c_proj.bias"] = ours[f"{src}.self_attn.out_proj.bias"]
+        out[f"{dst}.mlp.c_fc.weight"] = ours[f"{src}.linear1.weight"].T.copy()
+        out[f"{dst}.mlp.c_fc.bias"] = ours[f"{src}.linear1.bias"]
+        out[f"{dst}.mlp.c_proj.weight"] = ours[f"{src}.linear2.weight"].T.copy()
+        out[f"{dst}.mlp.c_proj.bias"] = ours[f"{src}.linear2.bias"]
+    return out
+
+
+def export_llama_state_dict(model: Module) -> Dict[str, np.ndarray]:
+    """Inverse of ``llama_state_dict_to_lm``: a Llama-shaped ``build_lm``
+    model (rope, rms, swiglu, bias-free) exported under HF
+    ``LlamaForCausalLM`` names (q/k/v split back out of the GQA
+    in_proj stack)."""
+    from bigdl_tpu.interop.state_dict import export_lm_state_dict
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+    ours = export_lm_state_dict(model)
+    mhas = [m for m in model.modules()
+            if isinstance(m, MultiHeadAttention)]
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": ours["embedding.weight"],
+        "model.norm.weight": ours["encoder.norm.weight"],
+    }
+    if "lm_head.weight" in ours:
+        out["lm_head.weight"] = ours["lm_head.weight"]
+    n_layers = 1 + max(int(k.split(".")[2]) for k in ours
+                       if k.startswith("encoder.layers."))
+    for i in range(n_layers):
+        src, dst = f"encoder.layers.{i}", f"model.layers.{i}"
+        attn = mhas[i]
+        e, ekv = attn.embed_dim, attn._e_kv
+        w = ours[f"{src}.self_attn.in_proj_weight"]
+        out[f"{dst}.self_attn.q_proj.weight"] = w[:e]
+        out[f"{dst}.self_attn.k_proj.weight"] = w[e:e + ekv]
+        out[f"{dst}.self_attn.v_proj.weight"] = w[e + ekv:]
+        out[f"{dst}.self_attn.o_proj.weight"] = \
+            ours[f"{src}.self_attn.out_proj.weight"]
+        out[f"{dst}.input_layernorm.weight"] = ours[f"{src}.norm1.weight"]
+        out[f"{dst}.post_attention_layernorm.weight"] = \
+            ours[f"{src}.norm2.weight"]
+        out[f"{dst}.mlp.gate_proj.weight"] = ours[f"{src}.linear1.weight"]
+        out[f"{dst}.mlp.up_proj.weight"] = ours[f"{src}.linear_gate.weight"]
+        out[f"{dst}.mlp.down_proj.weight"] = ours[f"{src}.linear2.weight"]
+    return out
+
+
+def _lm_geometry(model: Module):
+    """(embed, encoder, first MHA, head) of a build_lm-shaped model."""
+    from bigdl_tpu.interop.state_dict import _lm_parts
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+    emb, enc, head = _lm_parts(model)
+    mha = enc._modules["layer0"].self_attn
+    assert isinstance(mha, MultiHeadAttention)
+    return emb, enc, mha, head
+
+
+def save_hf_checkpoint(model: Module, path: str) -> str:
+    """Write ``config.json`` + ``model.safetensors`` so ``transformers``
+    loads the directory with ``from_pretrained`` — the full inverse of
+    ``load_hf_checkpoint``. The flavour is inferred from the model:
+    RoPE + RMSNorm + SwiGLU exports as a Llama config, a learned-position
+    LayerNorm/gelu stack as GPT-2. Returns the directory path."""
+    from safetensors.numpy import save_file
+    emb, enc, mha, head = _lm_geometry(model)
+    layer0 = enc._modules["layer0"]
+    is_llama = getattr(mha, "rope", False)
+    act = getattr(layer0, "activation", None)
+    # refuse, don't corrupt (the import-side policy, both directions):
+    # the exported config hardcodes the family activation
+    if is_llama and act != "swiglu":
+        raise ValueError(f"Llama-family export needs activation='swiglu' "
+                         f"(model has {act!r})")
+    if not is_llama and act != "gelu":
+        raise ValueError(f"GPT-2 export needs activation='gelu' "
+                         f"(= HF gelu_new; model has {act!r})")
+    os.makedirs(path, exist_ok=True)
+    if is_llama:
+        sd = export_llama_state_dict(model)
+        from bigdl_tpu.nn.linear import TiedLMHead
+        window = getattr(mha, "window", None)
+        config = {
+            # a sliding window makes it a Mistral-shaped checkpoint
+            "model_type": "mistral" if window else "llama",
+            "architectures": ["MistralForCausalLM" if window
+                              else "LlamaForCausalLM"],
+            **({"sliding_window": int(window)} if window else {}),
+            "vocab_size": int(emb.n_index),
+            "hidden_size": int(mha.embed_dim),
+            "intermediate_size": int(layer0.linear1.output_size),
+            "num_hidden_layers": int(enc.num_layers),
+            "num_attention_heads": int(mha.num_heads),
+            "num_key_value_heads": int(mha.num_kv_heads),
+            "max_position_embeddings": int(getattr(model, "lm_max_len",
+                                                   2048)),
+            "rms_norm_eps": float(layer0.norm1.eps),
+            "rope_theta": float(getattr(mha, "rope_theta", 10000.0)),
+            "hidden_act": "silu",
+            "attention_bias": False,
+            "mlp_bias": False,
+            "tie_word_embeddings": isinstance(head, TiedLMHead),
+            "torch_dtype": "float32",
+        }
+    else:
+        sd = export_gpt2_state_dict(model)
+        wpe = sd["transformer.wpe.weight"]
+        config = {
+            "model_type": "gpt2",
+            "architectures": ["GPT2LMHeadModel"],
+            "vocab_size": int(emb.n_index),
+            "n_positions": int(wpe.shape[0]),
+            "n_embd": int(mha.embed_dim),
+            "n_layer": int(enc.num_layers),
+            "n_head": int(mha.num_heads),
+            "n_inner": int(layer0.linear1.output_size),
+            "activation_function": "gelu_new",
+            "layer_norm_epsilon": float(layer0.norm1.eps),
+            "tie_word_embeddings": True,
+            "torch_dtype": "float32",
+        }
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(config, f, indent=2)
+    save_file({k: np.ascontiguousarray(v, np.float32)
+               for k, v in sd.items()},
+              os.path.join(path, "model.safetensors"))
+    return path
 
 
 # ------------------------------------------------------------- directory I/O

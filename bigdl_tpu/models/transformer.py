@@ -31,7 +31,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
              pos: str = "sinusoidal",
              bias: bool = True,
              head_bias: Optional[bool] = None,
-             norm_eps: Optional[float] = None) -> nn.Sequential:
+             norm_eps: Optional[float] = None,
+             window: Optional[int] = None) -> nn.Sequential:
     """Causal LM: 1-based token ids (N, T) -> log-probs (N, T, vocab).
 
     ``seq_axis="seq"`` shards every attention layer over the mesh sequence
@@ -71,6 +72,9 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
     ``head_bias`` overrides ``bias`` for the untied LM head."""
     embed = nn.LookupTable(vocab_size, embed_dim)
     m = nn.Sequential().add(embed)
+    # plain attribute (not a parameter): rope models have no positional
+    # table to infer context length from, so exporters read this
+    m.lm_max_len = max_len
     if not rope:
         if pos == "learned":
             m.add(nn.LearnedPositionalEncoding(embed_dim, max_len, dropout))
@@ -90,7 +94,7 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
                                 moe_k=moe_k, rope=rope,
                                 num_kv_heads=num_kv_heads,
                                 rope_theta=rope_theta, bias=bias,
-                                norm_eps=norm_eps))
+                                norm_eps=norm_eps, window=window))
     if tie_embeddings:
         return m.add(nn.TiedLMHead(embed))
     hb = bias if head_bias is None else head_bias

@@ -109,9 +109,24 @@ class MultiHeadAttention(Module):
                  seq_axis: Optional[str] = None, seq_mode: str = "ring",
                  seq_layout: str = "contiguous", rope: bool = False,
                  num_kv_heads: Optional[int] = None,
-                 rope_theta: float = 10000.0):
+                 rope_theta: float = 10000.0,
+                 window: Optional[int] = None):
         super().__init__()
         assert embed_dim % num_heads == 0, "embed_dim must divide num_heads"
+        # window: sliding-window (banded causal) attention — query i sees
+        # keys (i - window, i], the Mistral convention. Requires causal;
+        # runs on the XLA cores (the flash kernel and context-parallel
+        # paths do not implement the band and are excluded by dispatch).
+        if window is not None:
+            if not causal:
+                raise ValueError("window (sliding-window attention) "
+                                 "requires causal=True")
+            if seq_axis is not None:
+                raise ValueError("sliding-window attention does not "
+                                 "compose with context parallelism yet")
+            if window < 1:
+                raise ValueError("window must be >= 1")
+        self.window = window
         # GQA (grouped-query attention): num_kv_heads < num_heads shares
         # each k/v head across num_heads // num_kv_heads query heads — the
         # KV cache (decode's memory hog) shrinks by that factor. The
@@ -235,11 +250,17 @@ class MultiHeadAttention(Module):
                                 None)
         k_pos = jnp.arange(self.k_cache.shape[1])[None, :]
         q_pos = pos + jnp.arange(s)[:, None]
+        step_mask = k_pos <= q_pos
+        if getattr(self, "window", None):
+            # sliding window: only the last `window` cache entries are
+            # live (cache stays full-length; the rolling-cache memory
+            # optimisation is deliberately deferred — correctness first)
+            step_mask = step_mask & (k_pos > q_pos - self.window)
         n_kv = self.k_cache.shape[2]
         if n_kv == self.num_heads:
             return attention_core.dot_product_attention(
                 q, self.k_cache, self.v_cache,
-                mask=k_pos <= q_pos, causal=False)
+                mask=step_mask, causal=False)
         # GQA steady state: grouped einsum reads the cache at its SMALL
         # size (an expand-then-attend would copy the whole cache to full
         # head count every step, forfeiting the bandwidth win)
@@ -248,7 +269,7 @@ class MultiHeadAttention(Module):
         q_vec = q.reshape(b, n_kv, g, d)           # s == 1
         logits = jnp.einsum("bkgd,blkd->bkgl", q_vec, self.k_cache)
         logits = (logits * (1.0 / float(d) ** 0.5)).astype(jnp.float32)
-        valid = (k_pos[0] <= q_pos[0, 0])  # (L,)
+        valid = step_mask[0]  # (L,): causal (+ window band when set)
         logits = jnp.where(valid[None, None, None, :], logits,
                            jnp.finfo(jnp.float32).min)
         w = jax.nn.softmax(logits, axis=-1)
@@ -347,6 +368,15 @@ class MultiHeadAttention(Module):
             return context.ulysses_attention(q, k, v,
                                              axis_name=self.seq_axis,
                                              causal=self.causal)
+        if getattr(self, "window", None):
+            # banded causal: query i sees keys (i - window, i] (Mistral
+            # convention). The band rides the mask path, which already
+            # excludes the flash kernel.
+            sq, sk = q.shape[1], k.shape[1]
+            q_pos = jnp.arange(sq)[:, None]
+            k_pos = jnp.arange(sk)[None, :]
+            band = k_pos > q_pos - self.window
+            mask = band if mask is None else jnp.logical_and(mask, band)
         drop = self.dropout_p if (self.training and self.dropout_p) else 0.0
         if not drop:  # prob-dropout needs the plain core (see __init__)
             if flash_attention.use_flash(q, mask):
@@ -458,7 +488,8 @@ class TransformerEncoderLayer(Module):
                  moe_experts: int = 0, moe_k: int = 2, rope: bool = False,
                  norm: str = "layer", num_kv_heads: Optional[int] = None,
                  rope_theta: float = 10000.0, bias: bool = True,
-                 norm_eps: Optional[float] = None):
+                 norm_eps: Optional[float] = None,
+                 window: Optional[int] = None):
         super().__init__()
         from bigdl_tpu.nn.linear import Linear
         from bigdl_tpu.nn.regularization import Dropout
@@ -483,7 +514,8 @@ class TransformerEncoderLayer(Module):
                                             rope=rope,
                                             num_kv_heads=num_kv_heads,
                                             rope_theta=rope_theta,
-                                            with_bias=bias)
+                                            with_bias=bias,
+                                            window=window)
         if moe_experts:
             if activation == "swiglu":
                 raise ValueError("swiglu FFN does not compose with MoE yet")
@@ -563,7 +595,8 @@ class TransformerEncoder(Module):
                  moe_experts: int = 0, moe_k: int = 2, rope: bool = False,
                  norm: str = "layer", num_kv_heads: Optional[int] = None,
                  rope_theta: float = 10000.0, bias: bool = True,
-                 norm_eps: Optional[float] = None):
+                 norm_eps: Optional[float] = None,
+                 window: Optional[int] = None):
         super().__init__()
         self.num_layers = num_layers
         for i in range(num_layers):
@@ -573,7 +606,8 @@ class TransformerEncoder(Module):
                 block_size=block_size, seq_axis=seq_axis, seq_mode=seq_mode,
                 seq_layout=seq_layout, moe_experts=moe_experts, moe_k=moe_k,
                 rope=rope, norm=norm, num_kv_heads=num_kv_heads,
-                rope_theta=rope_theta, bias=bias, norm_eps=norm_eps))
+                rope_theta=rope_theta, bias=bias, norm_eps=norm_eps,
+                window=window))
         if not pre_norm:
             self.final_norm = None
         elif norm == "rms":

@@ -223,6 +223,23 @@ class TestPerfHarness:
                                   "--numBeams", "3", "--int8"])
         assert "continuation:" in capsys.readouterr().out
 
+    def test_transformer_generate_from_hf_checkpoint(self, capsys):
+        import os
+        from bigdl_tpu.apps import transformer
+        res = os.path.join(os.path.dirname(__file__), "resources",
+                           "hf_tiny_gpt2")
+        transformer.generate_cmd(["--fromHF", res, "--prompt", "5,17,42",
+                                  "--maxNewTokens", "4", "--greedy"])
+        out = capsys.readouterr().out
+        assert "prompt:       [5, 17, 42]" in out  # HF 0-based round trip
+        assert "continuation:" in out
+
+    def test_transformer_rejects_model_and_hf_together(self):
+        import pytest
+        from bigdl_tpu.apps import transformer
+        with pytest.raises(SystemExit, match="not both"):
+            transformer.generate_cmd(["--fromHF", "x", "--model", "y"])
+
     def test_context_parallel_matches_sequential_loss(self):
         # PE offsets + pmean correctness: first-step loss of the seq-parallel
         # path must equal the plain path on the same weights and batch
