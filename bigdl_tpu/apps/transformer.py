@@ -311,12 +311,21 @@ def generate_cmd(argv) -> None:
         raise SystemExit("--fromHF does not compose with --tokenizer (a "
                          "framework BPE vocab against an HF checkpoint's "
                          "vocab would decode garbage); pass raw HF ids")
+    tok = None
     if args.fromHF:
         from bigdl_tpu.interop.hf import load_hf_checkpoint
+        from bigdl_tpu.interop.hf_tokenizer import HFTokenizer
         model = load_hf_checkpoint(args.fromHF)
-        hf_shift = 1  # HF ids are 0-based; the framework's are 1-based
         if args.eosId is not None:
-            args.eosId += hf_shift  # the CLI eos is an HF id too
+            args.eosId += 1  # the CLI eos under --fromHF is an HF id
+        if HFTokenizer.present_in(args.fromHF):
+            # checkpoint dir carries its tokenizer: --prompt is TEXT and
+            # encode/decode already speak framework 1-based ids
+            tok = HFTokenizer.from_dir(args.fromHF)
+            print(f"loaded {tok!r} from the checkpoint dir; --prompt is "
+                  "text", file=sys.stderr)
+        else:
+            hf_shift = 1  # HF ids are 0-based; the framework's 1-based
     elif args.model:
         model = file_io.load(args.model)
     else:
@@ -325,10 +334,10 @@ def generate_cmd(argv) -> None:
         model = train(["-b", "8", "--seqLen", "32", "--maxEpoch", "1"])
     if args.int8:
         model = nn.quantize_model(model)
-    tok = None
     if args.tokenizer:
         from bigdl_tpu.dataset.bpe import BPETokenizer
         tok = BPETokenizer.load(args.tokenizer)
+    if tok is not None:
         ids = [float(t) for t in tok.encode(args.prompt)]
         if args.eosId is None:
             args.eosId = tok.eos_id
@@ -399,9 +408,20 @@ def serve_cmd(argv) -> None:
 
     if args.fromHF and args.model:
         raise SystemExit("pass --model or --fromHF, not both")
+    if args.fromHF and args.tokenizer:
+        raise SystemExit("--fromHF does not compose with --tokenizer (a "
+                         "framework BPE vocab against an HF checkpoint's "
+                         "vocab would decode garbage); the checkpoint "
+                         "dir's own tokenizer loads automatically")
+    tok = None
     if args.fromHF:
         from bigdl_tpu.interop.hf import load_hf_checkpoint
+        from bigdl_tpu.interop.hf_tokenizer import HFTokenizer
         model = load_hf_checkpoint(args.fromHF)
+        if HFTokenizer.present_in(args.fromHF):
+            tok = HFTokenizer.from_dir(args.fromHF)
+            print(f"serving with {tok!r} from the checkpoint dir",
+                  file=sys.stderr)
     elif args.model:
         model = file_io.load(args.model)
     else:
@@ -410,12 +430,11 @@ def serve_cmd(argv) -> None:
         model = train(["-b", "8", "--seqLen", "32", "--maxEpoch", "1"])
     if args.int8:
         model = nn.quantize_model(model)
-    tok = None
     if args.tokenizer:
         from bigdl_tpu.dataset.bpe import BPETokenizer
         tok = BPETokenizer.load(args.tokenizer)
-        if args.eosId is None:
-            args.eosId = tok.eos_id
+    if tok is not None and args.eosId is None:
+        args.eosId = tok.eos_id
     server = LMServer(model, max_batch=args.maxBatch,
                       batch_timeout_ms=args.batchTimeoutMs,
                       max_new_tokens=args.maxNewTokens,

@@ -165,17 +165,25 @@ def llama_lm_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
     if act != "silu":
         raise ValueError(f"unsupported Llama activation {act!r}")
     scaling = config.get("rope_scaling")
-    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
-        # Llama-3.1+ NTK/llama3 frequency scaling would silently change
-        # every attention score if ignored — refuse, don't corrupt
-        raise ValueError(f"rope_scaling {scaling!r} is not supported yet "
-                         "(plain rope_theta frequencies only)")
+    rope_scaling = None
+    if scaling:
+        rt = scaling.get("rope_type", scaling.get("type"))
+        if rt == "llama3":
+            # Llama-3.1 long-context frequency rescaling: implemented
+            # (nn.attention.llama3_scale_freqs, parity-tested)
+            rope_scaling = dict(scaling)
+        elif rt != "default":
+            # other scalings (linear/dynamic/yarn) would silently change
+            # every attention score if ignored — refuse, don't corrupt
+            raise ValueError(f"rope_scaling {scaling!r} is not supported "
+                             "yet (plain or llama3 frequencies only)")
     window = config.get("sliding_window")
     heads = int(config["num_attention_heads"])
     return dict(
         # Mistral-style sliding window maps to banded causal attention
         # (query i sees keys (i - window, i]); None = global
         window=int(window) if window else None,
+        rope_scaling=rope_scaling,
         vocab_size=int(config["vocab_size"]),
         embed_dim=int(config["hidden_size"]),
         num_heads=heads,
@@ -358,6 +366,8 @@ def save_hf_checkpoint(model: Module, path: str) -> str:
             "architectures": ["MistralForCausalLM" if window
                               else "LlamaForCausalLM"],
             **({"sliding_window": int(window)} if window else {}),
+            **({"rope_scaling": dict(mha.rope_scaling)}
+               if getattr(mha, "rope_scaling", None) else {}),
             "vocab_size": int(emb.n_index),
             "hidden_size": int(mha.embed_dim),
             "intermediate_size": int(layer0.linear1.output_size),

@@ -1,0 +1,192 @@
+"""GPT-2-style byte-level BPE tokenizer reader — the text half of the HF
+checkpoint interop (``interop/hf.py`` loads the weights; this loads the
+``tokenizer.json`` / ``vocab.json``+``merges.txt`` beside them, so
+``--fromHF`` serving speaks TEXT, not raw ids).
+
+Differences from the framework's own ``dataset.BPETokenizer`` (which keeps
+raw bytes as symbols 0..255 and assigns merge ids by rank): the HF/GPT-2
+scheme maps every byte through a printable-unicode table
+(``bytes_to_unicode``), splits text with the GPT-2 regex pre-tokenizer,
+and takes token ids from an ARBITRARY vocab assignment (``vocab.json``) —
+ids must match the checkpoint's embedding rows exactly, so they cannot be
+re-derived; they are read from the file.
+
+``encode`` returns FRAMEWORK 1-based ids (HF id + 1, matching how
+``interop.hf`` copies the embedding table verbatim) and ``decode`` takes
+them back — the class is drop-in where ``dataset.BPETokenizer`` is used
+(``apps.transformer generate/serve --tokenizer`` protocol: ``encode``,
+``decode``, ``eos_id``).
+
+Verified against the ``tokenizers`` library (the implementation HF runs)
+on round-trip corpora in ``tests/test_hf_tokenizer.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# the GPT-2 pre-tokenizer pattern (contractions, letter runs, number runs,
+# punctuation runs — each optionally space-prefixed — then whitespace)
+_PAT = (r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+|"
+        r" ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
+
+
+@lru_cache(maxsize=1)
+def bytes_to_unicode() -> Dict[int, str]:
+    """The GPT-2 byte<->printable-unicode table: printable ASCII/Latin-1
+    map to themselves, the rest shift into 256+ codepoints."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+class HFTokenizer:
+    """Byte-level BPE with an explicit vocab-id table (GPT-2 scheme)."""
+
+    def __init__(self, vocab: Dict[str, int],
+                 merges: Sequence[Tuple[str, str]],
+                 eos_token: str = "<|endoftext|>"):
+        import regex
+        self._pat = regex.compile(_PAT)
+        self.vocab = dict(vocab)
+        self._id_to_tok = {i: t for t, i in self.vocab.items()}
+        self._merges = [tuple(m) for m in merges]
+        self._ranks = {m: i for i, m in enumerate(self._merges)}
+        self._byte_enc = bytes_to_unicode()
+        self._byte_dec = {c: b for b, c in self._byte_enc.items()}
+        self._cache: Dict[str, List[str]] = {}
+        self._eos_tok = eos_token if eos_token in self.vocab else None
+
+    # ----------------------------------------------------------------- load
+    @classmethod
+    def from_dir(cls, path: str) -> "HFTokenizer":
+        """Read ``tokenizer.json`` (fast format) or ``vocab.json`` +
+        ``merges.txt`` from an HF checkpoint directory."""
+        tj = os.path.join(path, "tokenizer.json")
+        if os.path.exists(tj):
+            with open(tj, encoding="utf-8") as f:
+                data = json.load(f)
+            model = data.get("model", {})
+            if model.get("type") != "BPE":
+                raise ValueError(f"tokenizer.json model type "
+                                 f"{model.get('type')!r} is not BPE")
+            # refuse non-GPT-2 byte schemes (Llama SentencePiece-derived
+            # vocabs are model.type BPE too, but use \u2581 word marks /
+            # <0xNN> byte tokens and different pre-tokenizers — GPT-2
+            # byte-mapping them would silently mis-tokenize)
+            pre = data.get("pre_tokenizer") or {}
+            pres = (pre.get("pretokenizers", [pre])
+                    if pre.get("type") == "Sequence" else [pre])
+            if not any(p.get("type") == "ByteLevel" for p in pres):
+                raise ValueError(
+                    "tokenizer.json is not a GPT-2-style ByteLevel BPE "
+                    f"(pre_tokenizer {pre.get('type')!r}); Llama-family "
+                    "tokenizers are not supported by this reader")
+            merges = [tuple(m.split(" ", 1)) if isinstance(m, str)
+                      else tuple(m) for m in model["merges"]]
+            return cls(model["vocab"], merges)
+        vj = os.path.join(path, "vocab.json")
+        mt = os.path.join(path, "merges.txt")
+        if os.path.exists(vj) and os.path.exists(mt):
+            with open(vj, encoding="utf-8") as f:
+                vocab = json.load(f)
+            merges = []
+            with open(mt, encoding="utf-8") as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    if not line or line.startswith("#version"):
+                        continue
+                    merges.append(tuple(line.split(" ", 1)))
+            return cls(vocab, merges)
+        raise FileNotFoundError(
+            f"no tokenizer.json or vocab.json+merges.txt in {path}")
+
+    @staticmethod
+    def present_in(path: str) -> bool:
+        return (os.path.exists(os.path.join(path, "tokenizer.json"))
+                or (os.path.exists(os.path.join(path, "vocab.json"))
+                    and os.path.exists(os.path.join(path, "merges.txt"))))
+
+    # ------------------------------------------------------------------ BPE
+    def _bpe(self, mapped: str) -> List[str]:
+        cached = self._cache.get(mapped)
+        if cached is not None:
+            return cached
+        parts = list(mapped)
+        while len(parts) > 1:
+            ranked = [(self._ranks.get((parts[i], parts[i + 1])), i)
+                      for i in range(len(parts) - 1)]
+            ranked = [(r, i) for r, i in ranked if r is not None]
+            if not ranked:
+                break
+            rank, _ = min(ranked)
+            a, b = self._merges[rank]
+            j = 0
+            while j < len(parts) - 1:
+                if parts[j] == a and parts[j + 1] == b:
+                    parts[j: j + 2] = [a + b]
+                else:
+                    j += 1
+        if len(self._cache) < 65536:
+            self._cache[mapped] = parts
+        return parts
+
+    def encode(self, text: str) -> List[int]:
+        """Text -> FRAMEWORK 1-based ids (HF id + 1)."""
+        ids: List[int] = []
+        for piece in self._pat.findall(text):
+            mapped = "".join(self._byte_enc[b]
+                             for b in piece.encode("utf-8"))
+            for tok in self._bpe(mapped):
+                tid = self.vocab.get(tok)
+                if tid is None:  # byte fallback (unmerged byte runs)
+                    for ch in tok:
+                        cid = self.vocab.get(ch)
+                        if cid is None:
+                            raise ValueError(
+                                f"byte token {ch!r} missing from the vocab "
+                                "(tokenizer trained without the full "
+                                "ByteLevel alphabet) — refusing to drop "
+                                "input text silently")
+                        ids.append(cid + 1)
+                else:
+                    ids.append(tid + 1)
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        """FRAMEWORK 1-based ids -> text (unknown ids skipped)."""
+        chars = []
+        for i in ids:
+            tok = self._id_to_tok.get(int(i) - 1)
+            if tok is not None and tok != self._eos_tok:
+                chars.append(tok)
+        data = bytes(self._byte_dec[c] for c in "".join(chars)
+                     if c in self._byte_dec)
+        return data.decode("utf-8", errors="replace")
+
+    # -------------------------------------------------------------- surface
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    @property
+    def eos_id(self) -> Optional[int]:
+        """Framework 1-based eos id (None when the vocab has no eos)."""
+        if self._eos_tok is None:
+            return None
+        return self.vocab[self._eos_tok] + 1
+
+    def __repr__(self):
+        return (f"HFTokenizer(vocab={len(self.vocab)}, "
+                f"merges={len(self._ranks)})")

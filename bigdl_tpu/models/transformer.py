@@ -32,7 +32,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
              bias: bool = True,
              head_bias: Optional[bool] = None,
              norm_eps: Optional[float] = None,
-             window: Optional[int] = None) -> nn.Sequential:
+             window: Optional[int] = None,
+             rope_scaling: Optional[dict] = None) -> nn.Sequential:
     """Causal LM: 1-based token ids (N, T) -> log-probs (N, T, vocab).
 
     ``seq_axis="seq"`` shards every attention layer over the mesh sequence
@@ -94,7 +95,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
                                 moe_k=moe_k, rope=rope,
                                 num_kv_heads=num_kv_heads,
                                 rope_theta=rope_theta, bias=bias,
-                                norm_eps=norm_eps, window=window))
+                                norm_eps=norm_eps, window=window,
+                                rope_scaling=rope_scaling))
     if tie_embeddings:
         return m.add(nn.TiedLMHead(embed))
     hb = bias if head_bias is None else head_bias

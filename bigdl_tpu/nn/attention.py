@@ -110,7 +110,8 @@ class MultiHeadAttention(Module):
                  seq_layout: str = "contiguous", rope: bool = False,
                  num_kv_heads: Optional[int] = None,
                  rope_theta: float = 10000.0,
-                 window: Optional[int] = None):
+                 window: Optional[int] = None,
+                 rope_scaling: Optional[dict] = None):
         super().__init__()
         assert embed_dim % num_heads == 0, "embed_dim must divide num_heads"
         # window: sliding-window (banded causal) attention — query i sees
@@ -150,6 +151,8 @@ class MultiHeadAttention(Module):
                              "attention yet (per-shard global positions)")
         self.rope = rope
         self.rope_theta = rope_theta
+        # Llama-3.1-style "llama3" frequency rescaling dict (None = plain)
+        self.rope_scaling = rope_scaling
         # seq_axis: mesh axis name for context parallelism. When set, the
         # module must run inside shard_map with activations sharded
         # (B, S/P, E) on that axis; attention goes through
@@ -338,8 +341,9 @@ class MultiHeadAttention(Module):
             if self._decode:
                 pos = pos + self.decode_pos
             theta = getattr(self, "rope_theta", 10000.0)
-            q = rope_rotate(q, pos, theta)
-            k = rope_rotate(k, pos, theta)
+            scaling = getattr(self, "rope_scaling", None)
+            q = rope_rotate(q, pos, theta, scaling)
+            k = rope_rotate(k, pos, theta, scaling)
 
         if self._decode:
             ctx = self._attend_decode(q, k, v)
@@ -489,7 +493,8 @@ class TransformerEncoderLayer(Module):
                  norm: str = "layer", num_kv_heads: Optional[int] = None,
                  rope_theta: float = 10000.0, bias: bool = True,
                  norm_eps: Optional[float] = None,
-                 window: Optional[int] = None):
+                 window: Optional[int] = None,
+                 rope_scaling: Optional[dict] = None):
         super().__init__()
         from bigdl_tpu.nn.linear import Linear
         from bigdl_tpu.nn.regularization import Dropout
@@ -515,7 +520,8 @@ class TransformerEncoderLayer(Module):
                                             num_kv_heads=num_kv_heads,
                                             rope_theta=rope_theta,
                                             with_bias=bias,
-                                            window=window)
+                                            window=window,
+                                            rope_scaling=rope_scaling)
         if moe_experts:
             if activation == "swiglu":
                 raise ValueError("swiglu FFN does not compose with MoE yet")
@@ -596,7 +602,8 @@ class TransformerEncoder(Module):
                  norm: str = "layer", num_kv_heads: Optional[int] = None,
                  rope_theta: float = 10000.0, bias: bool = True,
                  norm_eps: Optional[float] = None,
-                 window: Optional[int] = None):
+                 window: Optional[int] = None,
+                 rope_scaling: Optional[dict] = None):
         super().__init__()
         self.num_layers = num_layers
         for i in range(num_layers):
@@ -607,7 +614,7 @@ class TransformerEncoder(Module):
                 seq_layout=seq_layout, moe_experts=moe_experts, moe_k=moe_k,
                 rope=rope, norm=norm, num_kv_heads=num_kv_heads,
                 rope_theta=rope_theta, bias=bias, norm_eps=norm_eps,
-                window=window))
+                window=window, rope_scaling=rope_scaling))
         if not pre_norm:
             self.final_norm = None
         elif norm == "rms":
@@ -644,8 +651,25 @@ class TransformerEncoder(Module):
         return x
 
 
+def llama3_scale_freqs(freqs: jax.Array, scaling: dict) -> jax.Array:
+    """Llama-3.1 long-context frequency rescaling (the "llama3" rope_type):
+    low frequencies (long wavelengths) slow by ``factor``, high
+    frequencies keep, a smooth band interpolates — matching HF
+    ``_compute_llama3_parameters`` so scaled checkpoints import with
+    logit parity (``tests/test_hf_interop.py``)."""
+    factor = float(scaling["factor"])
+    low_f = float(scaling.get("low_freq_factor", 1.0))
+    high_f = float(scaling.get("high_freq_factor", 4.0))
+    orig = float(scaling.get("original_max_position_embeddings", 8192))
+    wavelen = 2.0 * np.pi / freqs
+    smooth = (orig / wavelen - low_f) / (high_f - low_f)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    return (1.0 - smooth) * freqs / factor + smooth * freqs
+
+
 def rope_rotate(x: jax.Array, positions: jax.Array,
-                theta: float = 10000.0) -> jax.Array:
+                theta: float = 10000.0,
+                scaling: Optional[dict] = None) -> jax.Array:
     """Rotary position embedding (RoPE, Su et al.): rotate feature pairs of
     ``x`` (B, S, H, D) by angles proportional to absolute ``positions``
     (S,). Because rotations compose, q@k between positions i and j depends
@@ -656,10 +680,13 @@ def rope_rotate(x: jax.Array, positions: jax.Array,
     The pairing convention is HF-Llama's "rotate_half" (pair feature i
     with i + D/2), so Llama-family checkpoints import without any q/k
     permutation (``interop/hf.py``). ``theta`` is the frequency base:
-    10000 for Llama-1/2-era models, 500000 for Llama-3."""
+    10000 for Llama-1/2-era models, 500000 for Llama-3. ``scaling`` is
+    an optional Llama-3.1-style rope_scaling dict (``llama3_scale_freqs``)."""
     d = x.shape[-1]
     half = d // 2
     freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if scaling is not None:
+        freqs = llama3_scale_freqs(freqs, scaling)
     angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (S, half)
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]

@@ -19,15 +19,43 @@ from transformers import GPT2Config, GPT2LMHeadModel
 
 OUT = os.path.join(os.path.dirname(__file__), "hf_tiny_gpt2")
 
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "Pack my box with five dozen liquor jugs!",
+    "How vexingly quick daft zebras jump?",
+    "the quick brown foxes and the lazy dogs",
+] * 4
+
+VOCAB = 300  # tokenizer vocab == model vocab, so text serving works
+
+
+def _write_tokenizer():
+    from tokenizers import Tokenizer
+    from tokenizers.models import BPE
+    from tokenizers.trainers import BpeTrainer
+    from tokenizers.pre_tokenizers import ByteLevel
+    from tokenizers.decoders import ByteLevel as ByteLevelDecoder
+    tok = Tokenizer(BPE(unk_token=None))
+    tok.pre_tokenizer = ByteLevel(add_prefix_space=False, use_regex=True)
+    tok.decoder = ByteLevelDecoder()
+    trainer = BpeTrainer(vocab_size=VOCAB,
+                         special_tokens=["<|endoftext|>"],
+                         initial_alphabet=ByteLevel.alphabet(),
+                         show_progress=False)
+    tok.train_from_iterator(CORPUS, trainer)
+    tok.save(os.path.join(OUT, "tokenizer.json"))
+    return tok.get_vocab_size()
+
 
 def main():
     os.makedirs(OUT, exist_ok=True)
+    n_vocab = _write_tokenizer()
     torch.manual_seed(0)
-    cfg = GPT2Config(vocab_size=97, n_positions=64, n_embd=32, n_layer=2,
-                     n_head=4)
+    cfg = GPT2Config(vocab_size=n_vocab, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=4)
     model = GPT2LMHeadModel(cfg).eval()
     model.save_pretrained(OUT, safe_serialization=True)
-    ids = np.random.default_rng(0).integers(0, 97, (2, 24))
+    ids = np.random.default_rng(0).integers(0, n_vocab, (2, 24))
     with torch.no_grad():
         lp = torch.log_softmax(model(torch.as_tensor(ids)).logits, -1)
     np.save(os.path.join(OUT, "golden_input_ids.npy"), ids)

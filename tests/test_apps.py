@@ -223,12 +223,21 @@ class TestPerfHarness:
                                   "--numBeams", "3", "--int8"])
         assert "continuation:" in capsys.readouterr().out
 
-    def test_transformer_generate_from_hf_checkpoint(self, capsys):
+    def test_transformer_generate_from_hf_checkpoint(self, capsys,
+                                                     tmp_path):
+        # raw-HF-id mode: a checkpoint dir WITHOUT tokenizer files (copy
+        # the fixture minus tokenizer.json)
         import os
+        import shutil
         from bigdl_tpu.apps import transformer
         res = os.path.join(os.path.dirname(__file__), "resources",
                            "hf_tiny_gpt2")
-        transformer.generate_cmd(["--fromHF", res, "--prompt", "5,17,42",
+        bare = tmp_path / "bare"
+        bare.mkdir()
+        for f in ("config.json", "model.safetensors"):
+            shutil.copy(os.path.join(res, f), bare / f)
+        transformer.generate_cmd(["--fromHF", str(bare),
+                                  "--prompt", "5,17,42",
                                   "--maxNewTokens", "4", "--greedy"])
         out = capsys.readouterr().out
         assert "prompt:       [5, 17, 42]" in out  # HF 0-based round trip
@@ -363,3 +372,20 @@ class TestIngestBench:
                            "--budget", "5"])
         dec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert dec["records_per_sec"] > 0
+
+
+class TestFromHFTextServing:
+    """--fromHF on a checkpoint dir that carries its tokenizer: prompts
+    are TEXT end-to-end (the HFTokenizer auto-load path)."""
+
+    def test_generate_text_prompt_from_hf_dir(self, capsys):
+        import os
+        from bigdl_tpu.apps import transformer
+        res = os.path.join(os.path.dirname(__file__), "resources",
+                           "hf_tiny_gpt2")
+        transformer.generate_cmd(["--fromHF", res,
+                                  "--prompt", "the quick brown",
+                                  "--maxNewTokens", "6", "--greedy"])
+        out = capsys.readouterr().out
+        assert "prompt:       'the quick brown'" in out
+        assert "continuation:" in out

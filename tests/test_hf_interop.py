@@ -356,3 +356,55 @@ class TestMistralSlidingWindow:
         # must agree for the full length HF produced
         got = to_hf_ids(np.asarray(out))[:, :ref.shape[1]]
         assert np.array_equal(got, ref)
+
+
+class TestLlama3RopeScaling:
+    """Llama-3.1-style "llama3" rope_scaling imports with logit parity
+    (the frequency rescaling is implemented, not refused)."""
+
+    def _tiny_llama3(self, seed=0):
+        torch = _torch()
+        from transformers import LlamaConfig, LlamaForCausalLM
+        torch.manual_seed(seed)
+        cfg = LlamaConfig(
+            vocab_size=53, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            rms_norm_eps=1e-5, rope_theta=10000.0,
+            rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                          "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                          "original_max_position_embeddings": 32})
+        return cfg, LlamaForCausalLM(cfg).eval()
+
+    def test_scaled_logit_parity(self):
+        cfg, hf = self._tiny_llama3()
+        ids = np.random.default_rng(11).integers(0, 53, (2, 20))
+        model = load_llama(cfg.to_dict(), hf.state_dict())
+        with jax.default_matmul_precision("highest"):
+            ours = our_logprobs(model, ids)
+        ref = hf_logprobs(hf, ids)
+        assert np.abs(ours - ref).max() < 5e-5
+
+    def test_scaling_changes_logits(self):
+        # sanity: the rescale is real — scaled vs plain differ
+        cfg, hf = self._tiny_llama3()
+        ids = np.random.default_rng(12).integers(0, 53, (1, 20))
+        m_scaled = load_llama(cfg.to_dict(), hf.state_dict())
+        d = cfg.to_dict()
+        d["rope_scaling"] = None
+        m_plain = load_llama(d, hf.state_dict())
+        with jax.default_matmul_precision("highest"):
+            a = our_logprobs(m_scaled, ids)
+            b = our_logprobs(m_plain, ids)
+        # a tiny random model barely uses position info: HF's own
+        # scaled-vs-plain gap here is ~6e-4 — the point is that the gap
+        # EXISTS and is an order of magnitude above the 5e-5 parity bound
+        assert np.abs(a - b).max() > 1e-4
+
+    def test_unsupported_scaling_still_refused(self):
+        import pytest
+        cfg, hf = self._tiny_llama3()
+        d = cfg.to_dict()
+        d["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
+        with pytest.raises(ValueError, match="rope_scaling"):
+            load_llama(d, hf.state_dict())

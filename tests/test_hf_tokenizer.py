@@ -1,0 +1,102 @@
+"""HF GPT-2 tokenizer reader (`interop/hf_tokenizer.py`) — oracle is the
+`tokenizers` library (the implementation HF actually runs): train a
+byte-level BPE on sample text IN the test (zero egress), save
+tokenizer.json, read it with our parser, and require identical ids on
+held-out text."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.interop.hf_tokenizer import HFTokenizer, bytes_to_unicode
+
+tokenizers = pytest.importorskip("tokenizers")
+
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "Pack my box with five dozen liquor jugs!",
+    "How vexingly quick daft zebras jump?",
+    "Sphinx of black quartz, judge my vow.",
+    "the the the quick quick brown foxes 123 456 7890",
+    "  leading spaces and\ttabs\nand newlines  ",
+    "don't can't won't it's we're I'll they'd you've I'm",
+]
+
+HELD_OUT = [
+    "The five boxing wizards jump quickly, don't they?",
+    "a brand new sentence with 42 numbers and... punctuation!?",
+    "unicode: café naïve — emoji \U0001f600 works",
+    "",
+    " ",
+    "word",
+]
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    from tokenizers import Tokenizer
+    from tokenizers.models import BPE
+    from tokenizers.trainers import BpeTrainer
+    from tokenizers.pre_tokenizers import ByteLevel
+    from tokenizers.decoders import ByteLevel as ByteLevelDecoder
+    tok = Tokenizer(BPE(unk_token=None))
+    tok.pre_tokenizer = ByteLevel(add_prefix_space=False, use_regex=True)
+    tok.decoder = ByteLevelDecoder()
+    trainer = BpeTrainer(vocab_size=400, special_tokens=["<|endoftext|>"],
+                         initial_alphabet=ByteLevel.alphabet(),
+                         show_progress=False)
+    tok.train_from_iterator(CORPUS * 4, trainer)
+    d = tmp_path_factory.mktemp("hftok")
+    tok.save(str(d / "tokenizer.json"))
+    return tok, str(d)
+
+
+class TestHFTokenizerParity:
+    def test_encode_matches_tokenizers_lib(self, trained):
+        ref, d = trained
+        ours = HFTokenizer.from_dir(d)
+        for text in CORPUS + HELD_OUT:
+            want = ref.encode(text).ids
+            got = [i - 1 for i in ours.encode(text)]  # framework -> HF ids
+            assert got == want, f"mismatch on {text!r}"
+
+    def test_decode_roundtrip(self, trained):
+        _, d = trained
+        ours = HFTokenizer.from_dir(d)
+        for text in CORPUS + HELD_OUT:
+            assert ours.decode(ours.encode(text)) == text
+
+    def test_eos_id_is_framework_shifted(self, trained):
+        _, d = trained
+        ours = HFTokenizer.from_dir(d)
+        with open(os.path.join(d, "tokenizer.json")) as f:
+            vocab = json.load(f)["model"]["vocab"]
+        assert ours.eos_id == vocab["<|endoftext|>"] + 1
+
+    def test_present_in(self, trained, tmp_path):
+        _, d = trained
+        assert HFTokenizer.present_in(d)
+        assert not HFTokenizer.present_in(str(tmp_path))
+
+    def test_vocab_json_merges_txt_form(self, trained, tmp_path):
+        ref, d = trained
+        with open(os.path.join(d, "tokenizer.json")) as f:
+            model = json.load(f)["model"]
+        with open(tmp_path / "vocab.json", "w") as f:
+            json.dump(model["vocab"], f)
+        with open(tmp_path / "merges.txt", "w") as f:
+            f.write("#version: 0.2\n")
+            for m in model["merges"]:
+                f.write((m if isinstance(m, str) else " ".join(m)) + "\n")
+        ours = HFTokenizer.from_dir(str(tmp_path))
+        for text in HELD_OUT:
+            assert [i - 1 for i in ours.encode(text)] == ref.encode(text).ids
+
+
+class TestByteTable:
+    def test_bijective_256(self):
+        table = bytes_to_unicode()
+        assert len(table) == 256
+        assert len(set(table.values())) == 256
