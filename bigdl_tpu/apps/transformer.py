@@ -321,10 +321,14 @@ def generate_cmd(argv) -> None:
         if HFTokenizer.present_in(args.fromHF):
             # checkpoint dir carries its tokenizer: --prompt is TEXT and
             # encode/decode already speak framework 1-based ids
-            tok = HFTokenizer.from_dir(args.fromHF)
-            print(f"loaded {tok!r} from the checkpoint dir; --prompt is "
-                  "text", file=sys.stderr)
-        else:
+            try:
+                tok = HFTokenizer.from_dir(args.fromHF)
+                print(f"loaded {tok!r} from the checkpoint dir; --prompt "
+                      "is text", file=sys.stderr)
+            except ValueError as e:  # unreadable (e.g. Llama SentencePiece)
+                print(f"checkpoint tokenizer not readable ({e}); falling "
+                      "back to raw HF ids", file=sys.stderr)
+        if tok is None:
             hf_shift = 1  # HF ids are 0-based; the framework's 1-based
     elif args.model:
         model = file_io.load(args.model)
@@ -419,9 +423,13 @@ def serve_cmd(argv) -> None:
         from bigdl_tpu.interop.hf_tokenizer import HFTokenizer
         model = load_hf_checkpoint(args.fromHF)
         if HFTokenizer.present_in(args.fromHF):
-            tok = HFTokenizer.from_dir(args.fromHF)
-            print(f"serving with {tok!r} from the checkpoint dir",
-                  file=sys.stderr)
+            try:
+                tok = HFTokenizer.from_dir(args.fromHF)
+                print(f"serving with {tok!r} from the checkpoint dir",
+                      file=sys.stderr)
+            except ValueError as e:  # unreadable: serve raw framework ids
+                print(f"checkpoint tokenizer not readable ({e}); clients "
+                      "must POST id prompts", file=sys.stderr)
     elif args.model:
         model = file_io.load(args.model)
     else:

@@ -8,4 +8,4 @@ from bigdl_tpu.models import autoencoder
 from bigdl_tpu.models import rnn
 from bigdl_tpu.models import transformer
 from bigdl_tpu.models import vit
-from bigdl_tpu.models.generation import generate
+from bigdl_tpu.models.generation import generate, generate_speculative

@@ -443,3 +443,207 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
         model.set_training(was_training)
         _lock.release()
     return out[0] if squeeze else out
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (round 4)
+# ---------------------------------------------------------------------------
+
+def _set_decode_pos(buffers, value):
+    """Set every ``decode_pos`` leaf (MHA caches AND positional encodings)
+    to ``value`` — the cache-rewind primitive speculative decoding needs."""
+    import jax.tree_util as jtu
+
+    def visit(path, leaf):
+        key = str(path[-1].key) if path and hasattr(path[-1], "key") else ""
+        if key == "decode_pos":
+            return jnp.full_like(leaf, value)
+        return leaf
+
+    return jtu.tree_map_with_path(visit, buffers)
+
+
+def generate_speculative(target: Module, draft: Module, prompt,
+                         max_new_tokens: int, *, spec_len: int = 4,
+                         eos_id: Optional[int] = None,
+                         pad_id: Optional[int] = None) -> jax.Array:
+    """Greedy speculative decoding: the DRAFT proposes ``spec_len`` tokens
+    per round, the TARGET verifies them in ONE chunked forward, and the
+    longest matching prefix is accepted plus the target's own next token
+    (the bonus) — so each round emits 1..spec_len+1 tokens for one target
+    dispatch. Output is EXACTLY the target's greedy generation (the draft
+    only changes speed, never tokens; differentially tested).
+
+    TPU-first mechanics: every round has STATIC shapes (the draft runs a
+    fixed spec_len+1-step ``lax.scan`` — the +1 step writes the last
+    proposal into the draft's own cache so full acceptance stays
+    consistent; the target verifies a fixed (1, spec_len+1) chunk via the
+    warm-cache chunked attention path), acceptance is a mask reduction,
+    and the cache rewind is a ``decode_pos`` reset — stale entries beyond
+    it are overwritten by later writes. The whole decode is one jitted
+    ``lax.while_loop`` program.
+
+    B=1 only (acceptance length is per-row; a batched version would need
+    per-row cache positions). Draft and target must share the vocab.
+    """
+    prompt = jnp.asarray(prompt)
+    if prompt.ndim == 1:
+        prompt = prompt[None]
+    b, s0 = prompt.shape
+    if b != 1:
+        raise ValueError("speculative decoding is B=1 (per-row acceptance "
+                         "lengths need per-row cache positions)")
+    if spec_len < 1:
+        raise ValueError("spec_len must be >= 1")
+    k = int(spec_len)
+    cap = s0 + max_new_tokens + k + 2  # cache slack for over-appended chunks
+    if pad_id is None:
+        pad_id = eos_id if eos_id is not None else 1
+
+    t_mods = _decode_modules(target)
+    d_mods = _decode_modules(draft)
+    for pe in t_mods[1] + d_mods[1]:
+        if _pos_table_len(pe) < cap:
+            raise ValueError(
+                f"model max_len {_pos_table_len(pe)} < prompt + max_new + "
+                f"spec_len slack {cap}; rebuild with a larger max_len")
+
+    # deterministic acquisition order (by id) — concurrent
+    # generate_speculative(A, B) and (B, A) must not AB/BA-deadlock
+    _locks = [_apply_lock(m) for m in
+              sorted({id(target): target, id(draft): draft}.values(),
+                     key=id)]
+    for lk in _locks:
+        lk.acquire()
+    t_training, d_training = target.training, draft.training
+    try:
+        for model, (mhas, pes, heads) in ((target, t_mods), (draft, d_mods)):
+            model.evaluate_mode()
+            for m in mhas:
+                m.enable_decode(b, cap)
+            for m in pes + heads:
+                m.enable_decode()
+        t_params, t_bufs = target.functional_state()
+        d_params, d_bufs = draft.functional_state()
+        t_heads, d_heads = t_mods[2], d_mods[2]
+
+        def run(t_params, t_bufs, d_params, d_bufs, prompt):
+            # prefill both models with SLICED heads ((B, 1, V) — the full
+            # (B, S0, V) prefill log-probs are what head slicing exists to
+            # avoid); the flags flip before the chunk phase is traced
+            # below, and cache hits never re-read them
+            for m in t_heads + d_heads:
+                m._decode_all = False
+            t_out, t_bufs = functional_apply(target, t_params, t_bufs,
+                                             prompt, training=False)
+            cur = jnp.argmax(t_out[:, -1], axis=-1).astype(jnp.int32) + 1
+            _, d_bufs = functional_apply(draft, d_params, d_bufs, prompt,
+                                         training=False)
+            for m in t_heads + d_heads:
+                m._decode_all = True  # verification needs ALL chunk logits
+            out0 = jnp.full((b, max_new_tokens + k + 1), jnp.int32(pad_id))
+            # emit the prefill token as position 0
+            out0 = out0.at[:, 0].set(cur)
+            done0 = (cur == eos_id) if eos_id is not None else \
+                jnp.zeros_like(cur, bool)
+            pos0 = jnp.int32(s0)
+
+            def cond(carry):
+                _, _, _, count, _, done, _, _ = carry
+                return (count < max_new_tokens) & ~done[0]
+
+            def body(carry):
+                t_bufs, d_bufs, out, count, cur, done, t_pos, d_pos = carry
+
+                # draft: k proposals + one extra step that writes the last
+                # proposal into the draft cache (full-acceptance support)
+                def dstep(c, _):
+                    bufs, tok = c
+                    lp, bufs = functional_apply(
+                        draft, d_params, bufs,
+                        tok[:, None].astype(prompt.dtype), training=False)
+                    nxt = jnp.argmax(lp[:, -1], axis=-1).astype(jnp.int32) + 1
+                    return (bufs, nxt), nxt
+
+                (d_bufs, _), d_toks = jax.lax.scan(
+                    dstep, (d_bufs, cur), None, length=k + 1)
+                d_toks = d_toks[:k, :, 0] if d_toks.ndim == 3 else d_toks[:k]
+                d_props = d_toks.T if d_toks.ndim == 2 else d_toks[None]
+                # d_props: (B, k)
+
+                # target: one chunked verification forward over
+                # [cur, d_1..d_k] — logits for every position
+                chunk = jnp.concatenate(
+                    [cur[:, None], d_props], axis=1).astype(prompt.dtype)
+                t_lp, t_bufs = functional_apply(target, t_params, t_bufs,
+                                                chunk, training=False)
+                g = jnp.argmax(t_lp, axis=-1).astype(jnp.int32) + 1
+                # g[:, i] = target's token after consuming chunk[:, :i+1]
+
+                # longest matching prefix of proposals
+                match = d_props == g[:, :k]            # (B, k)
+                n_acc = jnp.argmin(
+                    jnp.concatenate([match, jnp.zeros((b, 1), bool)],
+                                    axis=1), axis=1)[0]  # first mismatch
+                bonus = g[0, n_acc]
+                # emitted this round: d_1..d_n, bonus  -> (k+1,) vector
+                emit = jnp.where(jnp.arange(k + 1) < n_acc,
+                                 jnp.concatenate(
+                                     [d_props[0],
+                                      jnp.zeros((1,), jnp.int32)]),
+                                 bonus)
+                emit = jnp.where(jnp.arange(k + 1) > n_acc, pad_id, emit)
+                n_emit = n_acc + 1
+                if eos_id is not None:
+                    is_eos = (emit == eos_id) & \
+                        (jnp.arange(k + 1) < n_emit)
+                    any_eos = jnp.any(is_eos)
+                    first_eos = jnp.argmax(is_eos)
+                    n_emit = jnp.where(any_eos, first_eos + 1, n_emit)
+                    done = done | any_eos
+                # stale tail beyond n_emit is pad (overwritten next round
+                # anyway, and the final mask re-pads)
+                out = jax.lax.dynamic_update_slice(
+                    out, emit[None].astype(out.dtype), (0, count))
+                count = count + n_emit
+                # rewind both caches to the accepted boundary
+                t_pos = t_pos + n_acc + 1
+                d_pos = d_pos + n_acc + 1
+                t_bufs = _set_decode_pos(t_bufs, t_pos)
+                d_bufs = _set_decode_pos(d_bufs, d_pos)
+                cur = bonus[None]
+                return (t_bufs, d_bufs, out, count, cur, done, t_pos, d_pos)
+
+            carry = (t_bufs, d_bufs, out0, jnp.int32(1), cur, done0,
+                     pos0, pos0)
+            carry = jax.lax.while_loop(cond, body, carry)
+            out, count = carry[2], carry[3]
+            # final mask: positions >= count -> pad; trim to max_new
+            keep = jnp.arange(out.shape[1])[None, :] < count
+            out = jnp.where(keep, out, pad_id)[:, :max_new_tokens]
+            return jnp.concatenate(
+                [prompt, out.astype(prompt.dtype)], axis=1)
+
+        cache = target.__dict__.setdefault("_spec_fns", {})
+        sig = (id(draft), b, s0, int(max_new_tokens), k, eos_id, pad_id)
+        fn = cache.get(sig)
+        if fn is None:
+            if len(cache) >= 8:
+                # bound the cache: each program closes over a draft Module
+                # (params included) — unbounded growth would pin dropped
+                # drafts resident forever
+                cache.clear()
+            fn = jax.jit(run)
+            cache[sig] = fn
+        result = fn(t_params, t_bufs, d_params, d_bufs, prompt)
+    finally:
+        for model, (mhas, pes, heads) in ((target, t_mods), (draft, d_mods)):
+            for m in heads:
+                m._decode_all = False
+            for m in mhas + pes + heads:
+                m.disable_decode()
+        target.set_training(t_training)
+        draft.set_training(d_training)
+        for lk in reversed(_locks):
+            lk.release()
+    return result

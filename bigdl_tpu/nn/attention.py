@@ -227,13 +227,15 @@ class MultiHeadAttention(Module):
     def _attend_decode(self, q, k, v):
         """Append k/v at ``decode_pos`` and attend the new queries.
 
-        Multi-token calls are the PROMPT PREFILL (``generate`` only ever
-        issues one, at position 0): the cache is cold, so the valid keys
-        are exactly the fresh k/v — attention runs through the standard
-        causal path (``_attend``), which keeps the flash-kernel dispatch
-        for long prompts and avoids materialising an (S, max_len) mask.
-        Single-token steady-state calls attend against the whole cache
-        with the position mask ``k_pos <= q_pos``."""
+        A multi-token call on a COLD cache is the prompt prefill: the
+        valid keys are exactly the fresh k/v, so attention runs through
+        the standard causal path (``_attend``) — keeping the flash-kernel
+        dispatch for long prompts and avoiding an (S, max_len) mask.
+        Every other call (single-token steady state, or a multi-token
+        CHUNK on a warm cache — chunked prefill / speculative
+        verification) attends against the whole cache with the position
+        mask ``k_pos <= q_pos`` (causal within the chunk, full history
+        before it)."""
         from bigdl_tpu.ops import attention_core
         pos = self.decode_pos
         self.k_cache = jax.lax.dynamic_update_slice(
@@ -242,13 +244,14 @@ class MultiHeadAttention(Module):
             self.v_cache, v.astype(self.v_cache.dtype), (0, pos, 0, 0))
         s = q.shape[1]
         self.decode_pos = pos + s
-        if s > 1:  # prefill: cache was cold, fresh k/v are the whole context
-            if self._decode_prefilled:
-                raise RuntimeError(
-                    "chunked prefill is not supported: a second multi-token "
-                    "forward in decode mode would ignore the cached context "
-                    "(re-enable_decode and prefill the full prompt at once)")
-            self._decode_prefilled = True
+        # ANY first call warms the cache — a 1-token prompt's prefill too,
+        # or a later multi-token chunk would be mis-read as cold and attend
+        # only its own k/v (round-4 review catch, reproduced on-chip)
+        first = not self._decode_prefilled
+        self._decode_prefilled = True
+        if s > 1 and first:
+            # cold-cache full-prompt prefill: fresh k/v ARE the whole
+            # context — keep the flash-dispatch fast path
             return self._attend(q, self._expand_kv(k), self._expand_kv(v),
                                 None)
         k_pos = jnp.arange(self.k_cache.shape[1])[None, :]
@@ -260,9 +263,14 @@ class MultiHeadAttention(Module):
             # optimisation is deliberately deferred — correctness first)
             step_mask = step_mask & (k_pos > q_pos - self.window)
         n_kv = self.k_cache.shape[2]
-        if n_kv == self.num_heads:
+        if n_kv == self.num_heads or s > 1:
+            # full MHA, or a GQA multi-token chunk (chunked prefill /
+            # speculative verification): expand the cache to full head
+            # count for this call — chunks are rare relative to the
+            # steady state, which keeps the small-cache einsum below
             return attention_core.dot_product_attention(
-                q, self.k_cache, self.v_cache,
+                q, self._expand_kv(self.k_cache),
+                self._expand_kv(self.v_cache),
                 mask=step_mask, causal=False)
         # GQA steady state: grouped einsum reads the cache at its SMALL
         # size (an expand-then-attend would copy the whole cache to full

@@ -297,7 +297,7 @@ class LMHead(Module):
             if self.with_bias:
                 return Table(input, self.weight, self.bias)
             return Table(input, self.weight)
-        if self._decode:
+        if self._decode and not getattr(self, "_decode_all", False):
             input = input[:, -1:]
         y = jnp.matmul(match_compute(input, self.weight), self.weight.T)
         if self.with_bias:
@@ -350,7 +350,7 @@ class TiedLMHead(Module):
         w = self.embed_ref.weight  # (V, E): the LIVE embedding parameter
         if self.training:
             return Table(input, w)
-        if self._decode:
+        if self._decode and not getattr(self, "_decode_all", False):
             input = input[:, -1:]
         y = jnp.matmul(match_compute(input, w), w.T)
         return jax.nn.log_softmax(y, axis=-1)

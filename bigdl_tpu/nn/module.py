@@ -307,6 +307,7 @@ class Module:
         d = self.__dict__.copy()
         d.pop("_jit_forward", None)  # jit wrappers don't serialize/deepcopy
         d.pop("_generate_fns", None)
+        d.pop("_spec_fns", None)  # speculative-decode program cache
         return d
 
     # ----------------------------------------------------- parameter flatten

@@ -306,7 +306,7 @@ class TimeDistributed(Module):
         return self
 
     def update_output(self, input):
-        if self._decode:
+        if self._decode and not getattr(self, "_decode_all", False):
             input = input[:, -1:]
         n, t = input.shape[0], input.shape[1]
         flat = jnp.reshape(input, (n * t,) + input.shape[2:])

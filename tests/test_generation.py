@@ -287,15 +287,24 @@ class TestBeamSearch:
 
 
 class TestDecodeGuards:
-    def test_chunked_prefill_rejected(self):
+    def test_chunked_prefill_accepted_and_correct(self):
+        # round-3 rejected a second multi-token forward; round 4 supports
+        # it (warm-cache chunks attend full history + causal-in-chunk)
         from bigdl_tpu.nn.attention import MultiHeadAttention
+        from bigdl_tpu.utils.rng import manual_seed
+        manual_seed(5)
         m = MultiHeadAttention(16, 2, causal=True).evaluate_mode()
+        x = jnp.asarray(np.random.default_rng(0).normal(0, 1, (1, 9, 16)),
+                        jnp.float32)
+        full = np.asarray(m.forward(x))
         m.enable_decode(1, 16)
-        m.forward(jnp.ones((1, 4, 16)))  # prefill OK
-        m.forward(jnp.ones((1, 1, 16)))  # steady state OK
-        with pytest.raises(RuntimeError, match="chunked prefill"):
-            m.forward(jnp.ones((1, 4, 16)))  # second multi-token: rejected
+        a = m.forward(x[:, :4])   # prefill
+        b = m.forward(x[:, 4:5])  # steady state
+        c = m.forward(x[:, 5:])   # warm multi-token chunk
         m.disable_decode()
+        got = np.concatenate([np.asarray(a), np.asarray(b), np.asarray(c)],
+                             axis=1)
+        np.testing.assert_allclose(got, full, rtol=2e-5, atol=2e-5)
 
     def test_num_beams_1_is_deterministic(self):
         model = tiny_lm()
@@ -625,3 +634,138 @@ class TestGQA:
         mesh = Mesh(np.array(jax.devices()), ("data",))
         got = generate(model, p, 5, greedy=True, mesh=mesh)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestChunkedPrefill:
+    """Round-4: multi-token forwards on a WARM cache are supported (the
+    round-3 RuntimeError is lifted) — long prompts can prefill in bounded
+    chunks, and each chunk's last-position log-probs must equal the
+    single-shot prefill's at the same position."""
+
+    def _lm(self, **kw):
+        from bigdl_tpu.models import transformer
+        from bigdl_tpu.utils.rng import manual_seed
+        manual_seed(31)
+        return transformer.build_lm(32, 16, 4, 32, num_layers=2,
+                                    max_len=64, **kw)
+
+    def _chunked_vs_single(self, lm, prompt, chunks):
+        import numpy as np
+        from bigdl_tpu.nn.attention import MultiHeadAttention, \
+            _AddedPositionBase
+        from bigdl_tpu.nn.linear import LMHead, TiedLMHead
+        from bigdl_tpu.nn.recurrent import TimeDistributed
+        lm.evaluate_mode()
+        full = np.asarray(lm.forward(prompt))          # (B, S, V)
+        mods = [m for m in lm.modules()
+                if isinstance(m, (MultiHeadAttention, _AddedPositionBase,
+                                  LMHead, TiedLMHead, TimeDistributed))]
+        for m in mods:
+            if isinstance(m, MultiHeadAttention):
+                m.enable_decode(prompt.shape[0], prompt.shape[1] + 4)
+            else:
+                m.enable_decode()
+        try:
+            outs = []
+            start = 0
+            for size in chunks:
+                outs.append(np.asarray(
+                    lm.forward(prompt[:, start:start + size])))
+                start += size
+        finally:
+            for m in mods:
+                m.disable_decode()
+        # chunk k's last position == position sum(chunks[:k+1])-1 of full
+        pos = -1
+        for size, out in zip(chunks, outs):
+            pos += size
+            np.testing.assert_allclose(out[:, -1], full[:, pos],
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_mha_chunked_prefill_matches_single_shot(self):
+        import numpy as np
+        lm = self._lm()
+        prompt = np.random.default_rng(0).integers(
+            1, 33, (2, 12)).astype(np.float32)
+        self._chunked_vs_single(lm, prompt, [5, 4, 3])
+
+    def test_gqa_rope_chunked_prefill_matches(self):
+        import numpy as np
+        lm = self._lm(num_kv_heads=2, rope=True, activation="swiglu",
+                      norm="rms", tie_embeddings=True)
+        prompt = np.random.default_rng(1).integers(
+            1, 33, (1, 10)).astype(np.float32)
+        self._chunked_vs_single(lm, prompt, [4, 1, 5])
+
+    def test_windowed_chunked_prefill_matches(self):
+        import numpy as np
+        lm = self._lm(rope=True, activation="swiglu", norm="rms",
+                      tie_embeddings=True, window=3)
+        prompt = np.random.default_rng(2).integers(
+            1, 33, (1, 9)).astype(np.float32)
+        self._chunked_vs_single(lm, prompt, [3, 3, 3])
+
+
+class TestSpeculativeDecoding:
+    """Greedy speculative decoding must emit EXACTLY the target's greedy
+    tokens — the draft changes speed, never output (differential tests
+    across draft quality, spec lengths, eos, and the Llama recipe)."""
+
+    def _lms(self, **kw):
+        from bigdl_tpu.models import transformer
+        from bigdl_tpu.utils.rng import manual_seed
+        manual_seed(41)
+        target = transformer.build_lm(32, 16, 4, 32, num_layers=2,
+                                      max_len=96, **kw)
+        draft = transformer.build_lm(32, 16, 2, 16, num_layers=1,
+                                     max_len=96, **kw)
+        return target, draft
+
+    def _check(self, target, draft, prompt, n, **kw):
+        from bigdl_tpu.models.generation import (generate,
+                                                 generate_speculative)
+        ref = np.asarray(generate(target, prompt, n, greedy=True,
+                                  eos_id=kw.get("eos_id")))
+        got = np.asarray(generate_speculative(target, draft, prompt, n,
+                                              **kw))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_matches_plain_greedy(self):
+        target, draft = self._lms()
+        prompt = np.array([[3., 5., 7.]])
+        self._check(target, draft, prompt, 16, spec_len=4)
+
+    def test_various_spec_lengths(self):
+        target, draft = self._lms()
+        prompt = np.array([[9., 1.]])
+        for k in (1, 2, 7):
+            self._check(target, draft, prompt, 11, spec_len=k)
+
+    def test_perfect_draft_is_target(self):
+        # draft == target: every proposal accepted, output still exact
+        target, _ = self._lms()
+        prompt = np.array([[4., 4., 2.]])
+        self._check(target, target, prompt, 12, spec_len=4)
+
+    def test_llama_recipe_with_gqa(self):
+        target, draft = self._lms(num_kv_heads=2, rope=True,
+                                  activation="swiglu", norm="rms",
+                                  tie_embeddings=True)
+        prompt = np.array([[3., 5., 7., 2.]])
+        self._check(target, draft, prompt, 14, spec_len=3)
+
+    def test_eos_freezes(self):
+        from bigdl_tpu.models.generation import (generate,
+                                                 generate_speculative)
+        target, draft = self._lms()
+        prompt = np.array([[3., 5., 7.]])
+        # find a token the target actually emits, declare it eos
+        ref = np.asarray(generate(target, prompt, 12, greedy=True))
+        eos = int(ref[0, 5])
+        self._check(target, draft, prompt, 12, spec_len=4, eos_id=eos)
+
+    def test_rejects_batch(self):
+        from bigdl_tpu.models.generation import generate_speculative
+        target, draft = self._lms()
+        with pytest.raises(ValueError, match="B=1"):
+            generate_speculative(target, draft, np.ones((2, 3)), 4)
