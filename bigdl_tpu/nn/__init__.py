@@ -32,7 +32,7 @@ from bigdl_tpu.nn.linear import (
     Add, CAdd, Mul, CMul, Scale, LMHead, TiedLMHead,
 )
 from bigdl_tpu.nn.quantized import (
-    quantize_model, quantize_module, quantize_array, QuantizedLinear,
+    quantize_model, quantize_module, quantize_array, cast_model, QuantizedLinear,
     QuantizedLMHead, QuantizedSpatialConvolution, QuantizedMultiHeadAttention,
     QuantizedLookupTable,
 )

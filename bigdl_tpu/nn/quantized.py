@@ -197,3 +197,28 @@ def quantize_model(model: Module, compute_dtype=jnp.bfloat16) -> Module:
             m.register_buffer(name, m._parameters.pop(name))
         m._param_regularizers.clear()
     return qmodel.evaluate_mode()
+
+
+def cast_model(model: Module, dtype=jnp.bfloat16) -> Module:
+    """Deep-copied inference twin with every float PARAMETER cast to
+    ``dtype`` (buffers keep their dtypes — positional tables cast at use).
+
+    The half-precision sibling of ``quantize_model``: B=1 decode at real
+    model sizes is WEIGHT-READ-bound (PERF.md round 4: 134M fp32 decodes
+    at its 536 MB/read floor), so halving the resident weight bytes
+    halves the per-token floor — with bf16's full exponent range, unlike
+    int8's scale quantisation. Training must instead use the master-weight
+    policy (``Optimizer.set_precision``); the cast twin is eval-only.
+    """
+    from bigdl_tpu.ops.precision import cast_tree
+    twin = model.clone_module()
+    for m in twin.modules():
+        # params become BUFFERS (the quantize_model freeze): the twin is
+        # structurally optimizer-invisible — training a bf16 tree with no
+        # fp32 master would silently underflow small updates
+        casted = cast_tree(dict(m._parameters), dtype)
+        for name in list(m._parameters):
+            m._parameters.pop(name)
+            m.register_buffer(name, casted[name])
+        m._param_regularizers.clear()
+    return twin.evaluate_mode()
