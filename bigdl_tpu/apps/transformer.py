@@ -292,12 +292,18 @@ def generate_cmd(argv) -> None:
     ap.add_argument("--minNewTokens", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--int8", action="store_true",
-                    help="decode with the int8 weight-only quantized twin")
+                    help="decode with the int8 weight-only quantized twin "
+                    "(footprint knob: 4x smaller resident weights)")
+    ap.add_argument("--bf16", action="store_true",
+                    help="decode with the bf16 cast twin (latency knob: "
+                    "measured 1.69x at 134M/B=1, PERF.md round 4)")
     ap.add_argument("--tokenizer", default=None,
                     help="BPE tokenizer path (from train --textFile): "
                     "--prompt is then TEXT and the continuation prints "
                     "as text")
     args = ap.parse_args(argv)
+    if args.int8 and args.bf16:
+        raise SystemExit("pick one of --int8 / --bf16")
 
     import jax
     import jax.numpy as jnp
@@ -338,6 +344,8 @@ def generate_cmd(argv) -> None:
         model = train(["-b", "8", "--seqLen", "32", "--maxEpoch", "1"])
     if args.int8:
         model = nn.quantize_model(model)
+    elif args.bf16:
+        model = nn.cast_model(model)
     if args.tokenizer:
         from bigdl_tpu.dataset.bpe import BPETokenizer
         tok = BPETokenizer.load(args.tokenizer)
@@ -403,10 +411,14 @@ def serve_cmd(argv) -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--int8", action="store_true",
                     help="serve the int8 weight-only quantized twin")
+    ap.add_argument("--bf16", action="store_true",
+                    help="serve the bf16 cast twin (decode latency knob)")
     ap.add_argument("--tokenizer", default=None,
                     help="BPE tokenizer path: requests may then POST "
                     '{"text": ...} and responses include decoded text')
     args = ap.parse_args(argv)
+    if args.int8 and args.bf16:
+        raise SystemExit("pick one of --int8 / --bf16")
 
     from bigdl_tpu.models.lm_server import LMServer, make_http_server
 
@@ -438,6 +450,8 @@ def serve_cmd(argv) -> None:
         model = train(["-b", "8", "--seqLen", "32", "--maxEpoch", "1"])
     if args.int8:
         model = nn.quantize_model(model)
+    elif args.bf16:
+        model = nn.cast_model(model)
     if args.tokenizer:
         from bigdl_tpu.dataset.bpe import BPETokenizer
         tok = BPETokenizer.load(args.tokenizer)

@@ -296,6 +296,7 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
              num_beams: int = 0, length_penalty: float = 1.0,
              mesh=None, data_axis: str = "data",
              tensor_axis: Optional[str] = None,
+             rolling_cache: bool = False,
              key: Optional[jax.Array] = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -348,6 +349,14 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
                 f"{total}; rebuild the model with a larger max_len")
     if pad_id is None:
         pad_id = eos_id if eos_id is not None else 1
+    if rolling_cache:
+        bad = [m for m in mhas if not getattr(m, "window", None)]
+        if bad:
+            # validated BEFORE the apply lock is acquired — raising between
+            # acquire() and the try/finally would leak the lock forever
+            raise ValueError("rolling_cache requires every attention layer "
+                             "to have a sliding window (window=N): an "
+                             "unbounded-context layer needs every past key")
 
     # the whole enable_decode -> functional_state -> run -> disable_decode
     # window holds the per-root apply lock (reentrant — functional_state
@@ -361,7 +370,7 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
     try:
         model.evaluate_mode()
         for m in mhas:
-            m.enable_decode(b, total)
+            m.enable_decode(b, total, rolling=rolling_cache)
         for m in pes + heads:
             m.enable_decode()
         params, buffers = model.functional_state()
@@ -418,7 +427,7 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
         sig = (b, s0, max_new_tokens, float(temperature), int(top_k),
                float(top_p), bool(greedy), eos_id, pad_id,
                float(repetition_penalty), int(min_new_tokens),
-               int(num_beams), float(length_penalty))
+               int(num_beams), float(length_penalty), bool(rolling_cache))
         fn = cache.get(sig)
         if fn is None:
             if num_beams > 1:

@@ -195,7 +195,12 @@ class MultiHeadAttention(Module):
         self.attn_mask: Optional[jax.Array] = None
 
     # ------------------------------------------------------------- decoding
-    def enable_decode(self, batch_size: int, max_len: int) -> "MultiHeadAttention":
+    #: rolling-ring cache mode (enable_decode(rolling=True); requires a
+    #: sliding window). Class attr for pickle forward-compat.
+    _rolling = False
+
+    def enable_decode(self, batch_size: int, max_len: int,
+                      rolling: bool = False) -> "MultiHeadAttention":
         """Switch to incremental-decode mode with a (B, max_len) KV cache.
 
         The cache and write position are registered BUFFERS, so under
@@ -203,16 +208,29 @@ class MultiHeadAttention(Module):
         returns a new buffer tree with the appended K/V and advanced
         position — exactly the carry a jitted ``lax.scan`` decode loop
         needs (``models/generation.py``). The module object itself is never
-        mutated by traced steps."""
+        mutated by traced steps.
+
+        ``rolling=True`` (sliding-window models only): the cache is a RING
+        of ``window`` slots instead of ``max_len`` — decode memory becomes
+        O(window) regardless of generation length. Chunks attend the
+        concatenation [ring, fresh k/v] BEFORE the ring is overwritten
+        (an in-chunk write could destroy a slot an earlier chunk row still
+        needs), then the chunk's last ``window`` entries scatter in."""
         if self.seq_axis is not None:
             raise ValueError("decode mode is incompatible with "
                              "context-parallel attention (seq_axis)")
+        if rolling and not getattr(self, "window", None):
+            raise ValueError("rolling cache requires sliding-window "
+                             "attention (window=N): an unbounded-context "
+                             "model needs every past key")
         dt = self.in_proj_weight.dtype
-        shape = (batch_size, max_len,
+        cache_len = min(self.window, max_len) if rolling else max_len
+        shape = (batch_size, cache_len,
                  getattr(self, "num_kv_heads", self.num_heads),
                  self.head_dim)
         self._decode = True
         self._decode_prefilled = False
+        self._rolling = rolling
         self.register_buffer("k_cache", jnp.zeros(shape, dt))
         self.register_buffer("v_cache", jnp.zeros(shape, dt))
         self.register_buffer("decode_pos", jnp.zeros((), jnp.int32))
@@ -220,6 +238,7 @@ class MultiHeadAttention(Module):
 
     def disable_decode(self) -> "MultiHeadAttention":
         self._decode = False
+        self._rolling = False
         for name in ("k_cache", "v_cache", "decode_pos"):
             self._buffers.pop(name, None)
         return self
@@ -237,6 +256,8 @@ class MultiHeadAttention(Module):
         mask ``k_pos <= q_pos`` (causal within the chunk, full history
         before it)."""
         from bigdl_tpu.ops import attention_core
+        if getattr(self, "_rolling", False):
+            return self._attend_decode_rolling(q, k, v)
         pos = self.decode_pos
         self.k_cache = jax.lax.dynamic_update_slice(
             self.k_cache, k.astype(self.k_cache.dtype), (0, pos, 0, 0))
@@ -287,6 +308,67 @@ class MultiHeadAttention(Module):
         ctx = jnp.einsum("bkgl,blkd->bkgd", w.astype(self.v_cache.dtype),
                          self.v_cache)
         return ctx.reshape(b, 1, h, d)
+
+    def _attend_decode_rolling(self, q, k, v):
+        """Ring-cache decode step: attend [ring, fresh] BEFORE writing
+        (an in-chunk ring write could destroy a slot an earlier chunk row
+        still needs), then scatter the chunk's last ``ring`` entries in.
+
+        Ring slot ``j`` holds the kv of the LARGEST absolute position
+        <= decode_pos-1 congruent to j (mod ring size); the mask admits it
+        for query at absolute p iff that position is >= 0 and within the
+        window (p - window, p]. NOTE: decode_pos rewinds (speculative
+        decoding) are NOT supported on a ring — a rejected chunk's writes
+        have already destroyed older slots."""
+        from bigdl_tpu.ops import attention_core
+        w = self.k_cache.shape[1]
+        win = self.window
+        pos = self.decode_pos
+        s = q.shape[1]
+        j = jnp.arange(w)[None, :]
+        p_i = pos + jnp.arange(s)[:, None]            # abs position per row
+        last = pos - 1
+        a_j = last - jnp.mod(last - j, w)             # slot abs positions
+        ring_valid = (a_j >= 0) & (a_j > p_i - win)
+        t = jnp.arange(s)[None, :]
+        fresh_valid = (t <= jnp.arange(s)[:, None]) & ((pos + t) > p_i - win)
+        mask = jnp.concatenate([ring_valid, fresh_valid], axis=1)
+        keys = jnp.concatenate(
+            [self.k_cache, k.astype(self.k_cache.dtype)], axis=1)
+        vals = jnp.concatenate(
+            [self.v_cache, v.astype(self.v_cache.dtype)], axis=1)
+        n_kv = self.k_cache.shape[2]
+        if s == 1 and n_kv != self.num_heads:
+            # GQA steady state: grouped einsum reads the ring at its SMALL
+            # kv size (mirror of the linear-cache path — expand-then-attend
+            # would copy the whole ring to full head count every token)
+            b, _, h, d = q.shape
+            g = h // n_kv
+            q_vec = q.reshape(b, n_kv, g, d)
+            logits = jnp.einsum("bkgd,blkd->bkgl", q_vec, keys)
+            logits = (logits * (1.0 / float(d) ** 0.5)).astype(jnp.float32)
+            logits = jnp.where(mask[0][None, None, None, :], logits,
+                               jnp.finfo(jnp.float32).min)
+            wts = jax.nn.softmax(logits, axis=-1)
+            ctx = jnp.einsum("bkgl,blkd->bkgd", wts.astype(vals.dtype),
+                             vals).reshape(b, 1, h, d)
+        else:
+            ctx = attention_core.dot_product_attention(
+                q, self._expand_kv(keys), self._expand_kv(vals),
+                mask=mask, causal=False)
+        if s > w:  # only the chunk's last w entries survive; unique slots
+            k_wr, v_wr = k[:, -w:], v[:, -w:]
+            wr_idx = jnp.mod(pos + s - w + jnp.arange(w), w)
+        else:
+            k_wr, v_wr = k, v
+            wr_idx = jnp.mod(pos + jnp.arange(s), w)
+        self.k_cache = self.k_cache.at[:, wr_idx].set(
+            k_wr.astype(self.k_cache.dtype))
+        self.v_cache = self.v_cache.at[:, wr_idx].set(
+            v_wr.astype(self.v_cache.dtype))
+        self.decode_pos = pos + s
+        self._decode_prefilled = True
+        return ctx
 
     def set_mask(self, mask: Optional[jax.Array]) -> "MultiHeadAttention":
         """Static structural mask (baked in at trace time — see class doc;

@@ -769,3 +769,68 @@ class TestSpeculativeDecoding:
         target, draft = self._lms()
         with pytest.raises(ValueError, match="B=1"):
             generate_speculative(target, draft, np.ones((2, 3)), 4)
+
+
+class TestRollingKVCache:
+    """Ring cache for sliding-window models: O(window) decode memory with
+    token-identical output vs the full-length cache."""
+
+    def _lm(self, window=4):
+        from bigdl_tpu.models import transformer
+        from bigdl_tpu.utils.rng import manual_seed
+        manual_seed(51)
+        return transformer.build_lm(32, 16, 4, 32, num_layers=2,
+                                    max_len=128, rope=True,
+                                    activation="swiglu", norm="rms",
+                                    tie_embeddings=True, window=window)
+
+    def test_matches_full_cache_generation(self):
+        lm = self._lm(window=4)
+        p = np.array([[3., 5., 7.]])
+        full = np.asarray(generate(lm, p, 24, greedy=True))
+        rolled = np.asarray(generate(lm, p, 24, greedy=True,
+                                     rolling_cache=True))
+        np.testing.assert_array_equal(rolled, full)
+
+    def test_long_prompt_beyond_window(self):
+        lm = self._lm(window=3)
+        p = np.random.default_rng(0).integers(1, 33, (1, 17)) \
+            .astype(np.float32)
+        full = np.asarray(generate(lm, p, 15, greedy=True))
+        rolled = np.asarray(generate(lm, p, 15, greedy=True,
+                                     rolling_cache=True))
+        np.testing.assert_array_equal(rolled, full)
+
+    def test_cache_is_window_sized(self):
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        lm = self._lm(window=5)
+        mha = next(m for m in lm.modules()
+                   if isinstance(m, MultiHeadAttention))
+        mha.enable_decode(1, 64, rolling=True)
+        assert mha.k_cache.shape[1] == 5  # ring == window, not 64
+        mha.disable_decode()
+
+    def test_sampled_generation_matches(self):
+        import jax
+        lm = self._lm(window=4)
+        p = np.array([[9., 1.]])
+        a = np.asarray(generate(lm, p, 12, top_k=5,
+                                key=jax.random.PRNGKey(3)))
+        b = np.asarray(generate(lm, p, 12, top_k=5, rolling_cache=True,
+                                key=jax.random.PRNGKey(3)))
+        np.testing.assert_array_equal(a, b)
+
+    def test_rejects_unwindowed_model(self):
+        from bigdl_tpu.models import transformer
+        lm = transformer.build_lm(32, 16, 4, 32, num_layers=1, max_len=64)
+        with pytest.raises(ValueError, match="window"):
+            generate(lm, np.ones((1, 3)), 4, greedy=True,
+                     rolling_cache=True)
+
+    def test_beam_search_on_ring(self):
+        lm = self._lm(window=4)
+        p = np.array([[3., 5.]])
+        full = np.asarray(generate(lm, p, 10, num_beams=3))
+        rolled = np.asarray(generate(lm, p, 10, num_beams=3,
+                                     rolling_cache=True))
+        np.testing.assert_array_equal(rolled, full)
