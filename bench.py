@@ -103,17 +103,23 @@ def _timed_loop(step, state, budget_s, max_steps, batch):
     done = 0
     t0 = time.monotonic()
     chunk = 5
-    while done < max_steps:
+    over_budget = False
+    while done < max_steps and not over_budget:
         n = min(chunk, max_steps - done)
         for _ in range(n):
             state = step(state)
+            done += 1
+            # per-dispatch budget check: at large K each dispatch is
+            # seconds of device work, so a per-chunk check could commit
+            # to minutes past the budget and get the worker killed
+            if time.monotonic() - t_start > budget_s:
+                over_budget = True
+                break
         force(state)
-        done += n
         elapsed = time.monotonic() - t0
         log(f"timed {done}/{max_steps} steps, {elapsed:.1f}s")
-        if time.monotonic() - t_start > budget_s:
+        if over_budget:
             log("phase budget reached; stopping early with partial steps")
-            break
     elapsed = time.monotonic() - t0
     if done == 0 or elapsed <= 0:
         raise RuntimeError("no timed steps completed inside budget")
@@ -282,18 +288,21 @@ def worker_train(name, batch, steps, budget_s, precision="bf16",
         return new_params, new_buf, new_opt
 
     # K optimizer steps per dispatch: one fori_loop'd program amortizes the
-    # per-call host/tunnel overhead (the ~500-leaf pytree flatten + RPC per
-    # step costs ~15 ms on the tunneled backend — measured 99 ms on-device
-    # vs 114 ms wall without this). Constant input per step matches the
-    # reference harness's constant-data mode (DistriOptimizerPerf.scala:32).
-    # On CPU fallbacks there is no RPC to amortize and steps are seconds
-    # long — K=1 keeps the budget checks fine-grained so slow workers
-    # emit partial numbers instead of dying at the timeout.
+    # per-call host/tunnel overhead. Round-5 slope-timed measurement
+    # (scripts/resnet_ablate.py): the tunnel charges ~30 ms of fixed RPC
+    # overhead per DISPATCH (not per fetch), so K=5 left ~6% of the ResNet
+    # headline on the table (94.3 ms/step on-device vs 100.5 ms wall at
+    # K=5). K=60 cuts the overhead share under 1%. Constant input per
+    # step matches the reference harness's constant-data mode
+    # (DistriOptimizerPerf.scala:32). On CPU fallbacks there is no RPC to
+    # amortize and steps are seconds long — K=1 keeps the budget checks
+    # fine-grained so slow workers emit partial numbers instead of dying
+    # at the timeout.
     try:
         K = max(1, int(os.environ.get("BIGDL_TPU_BENCH_K", "") or
-                       (5 if jax.default_backend() == "tpu" else 1)))
+                       (60 if jax.default_backend() == "tpu" else 1)))
     except ValueError:
-        K = 5 if jax.default_backend() == "tpu" else 1
+        K = 60 if jax.default_backend() == "tpu" else 1
 
     def multi_step(params, buffers, opt_state, data, labels):
         def body(_, st):

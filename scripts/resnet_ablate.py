@@ -1,0 +1,150 @@
+"""ResNet-50 step ablation battery (round 5, VERDICT #1 follow-up).
+
+With the slope-timed roofline showing copy 656 / read 770 GB/s (80-94% of
+spec — see roofline_pallas.py), the round-4 "step is at the roof" argument
+needs re-examination against honest numbers. This measures, slope-timed
+(RTT cancelled):
+
+- ``full``: the standard b=256 train step (the headline).
+- ``nobn``: BatchNorm swapped for per-channel bias — quantifies the BN
+  stats+normalize byte share of the step.
+- ``fwd``: forward+loss only — splits fwd from bwd cost.
+- ``b512``: full step at batch 512 — fusion/overhead scaling check.
+
+Each entry also records XLA cost_analysis bytes and the implied GB/s.
+
+Usage: python scripts/resnet_ablate.py [--skip full,nobn,fwd,b512]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from roofline_pallas import _calibrate  # noqa: E402
+
+
+def _build(batch, nobn=False):
+    import jax.numpy as jnp
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import resnet
+
+    if nobn:
+        # per-channel learnable bias: same parameter motion, none of the
+        # stats/normalize passes
+        real_bn = nn.SpatialBatchNormalization
+
+        class _BiasOnly(nn.CAdd):
+            def __init__(self, n_out, *a, **k):
+                super().__init__((n_out,))
+
+        nn.SpatialBatchNormalization = _BiasOnly
+        try:
+            model = resnet.build(1000, depth=50)
+        finally:
+            nn.SpatialBatchNormalization = real_bn
+    else:
+        model = resnet.build(1000, depth=50)
+    crit = nn.ClassNLLCriterion()
+    x = jnp.ones((batch, 224, 224, 3), jnp.bfloat16)
+    y = jnp.ones((batch,), jnp.float32)
+    return model, crit, x, y
+
+
+def bench_step(batch, nobn=False, fwd_only=False):
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.nn.module import functional_apply
+    from bigdl_tpu.ops.precision import DtypePolicy
+    from bigdl_tpu.optim.methods import SGD
+
+    model, crit, x, y = _build(batch, nobn=nobn)
+    policy = DtypePolicy.bf16()
+    optim = SGD(learningrate=0.1, momentum=0.9)
+    params = model.parameter_tree()
+    buffers = model.buffer_tree()
+    state = optim.init_state(params)
+
+    def loss_of(p, buffers):
+        p_c = policy.cast_params_for_compute(p)
+        out, nb = functional_apply(model, p_c, buffers, x, training=True)
+        return crit.apply(out, y).astype(jnp.float32), nb
+
+    if fwd_only:
+        def step(carry):
+            params, buffers, state = carry
+            loss, nb = loss_of(params, buffers)
+            # fold loss into a param leaf so chained passes stay dependent
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            leaves[0] = leaves[0] + (loss * 0).astype(leaves[0].dtype)
+            params = jax.tree_util.tree_unflatten(treedef, leaves)
+            return params, nb, state
+    else:
+        def step(carry):
+            params, buffers, state = carry
+
+            def loss_fn(p):
+                return loss_of(p, buffers)
+
+            grads, nb = jax.grad(loss_fn, has_aux=True)(params)
+            new_p, new_s = optim.update(grads, state, params)
+            return new_p, nb, new_s
+
+    def make(k):
+        return jax.jit(lambda c: jax.lax.fori_loop(
+            0, k, lambda i, t: step(t), c))
+
+    # cost analysis from the single-step program
+    single = jax.jit(step)
+    compiled = single.lower((params, buffers, state)).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+
+    from roofline_pallas import _slope_timed
+    t = _slope_timed(make, lambda o: o, (params, buffers, state),
+                     k_small=2, k_large=10, iters=2)
+    bytes_step = float(ca.get("bytes accessed", 0.0))
+    return {
+        "batch": batch,
+        "step_ms": round(t * 1e3, 2),
+        "img_per_s": round(batch / t, 1),
+        "cost_analysis_gb": round(bytes_step / 1e9, 1),
+        "implied_gbps": round(bytes_step / t / 1e9, 1),
+        "flops_tf": round(float(ca.get("flops", 0.0)) / 1e12, 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip", default="")
+    args = ap.parse_args()
+    skip = set(args.skip.split(","))
+
+    for attempt in range(20):
+        cal, fixed = _calibrate()
+        print(json.dumps({"calibration_matmul_ms": round(cal, 1),
+                          "fixed_overhead_ms": round(fixed, 1)}), flush=True)
+        if cal < 12.0:
+            break
+        time.sleep(20)
+
+    res = {}
+    for name, kw in (("full", {"batch": 256}),
+                     ("nobn", {"batch": 256, "nobn": True}),
+                     ("fwd", {"batch": 256, "fwd_only": True}),
+                     ("b512", {"batch": 512})):
+        if name in skip:
+            continue
+        try:
+            res[name] = bench_step(**kw)
+        except Exception as e:  # noqa: BLE001
+            res[name] = {"error": str(e)[:300]}
+        print(json.dumps({name: res[name]}), flush=True)
+    print(json.dumps({"resnet_ablate": res}))
+
+
+if __name__ == "__main__":
+    main()
