@@ -43,16 +43,27 @@ def _gen(args) -> None:
 
 
 def _pipeline(args):
-    """Full host path: stream -> MT decode/normalize -> collate -> prefetch."""
+    """Full host path: stream -> decode/normalize -> collate -> prefetch.
+
+    ``--native`` (default on): whole-batch threaded C++ decode
+    (``NativeBGRBatchDecoder``); ``--no-native``: the round-4 per-record
+    MT pipeline, kept as the A/B baseline."""
     from bigdl_tpu.dataset.base import Prefetch
-    from bigdl_tpu.dataset.image import (BGRImgNormalizer, BytesToBGRImg,
-                                         MTLabeledBGRImgToBatch)
     from bigdl_tpu.dataset.shards import ShardFolder
-    mt = MTLabeledBGRImgToBatch(
-        224, 224, args.batchSize,
-        transformer=BytesToBGRImg(224, 224) >> BGRImgNormalizer(127.5, 73.0),
-        workers=args.workers)
-    return ShardFolder.stream(args.shards) >> mt >> Prefetch(args.prefetch)
+    if getattr(args, "native", True):
+        from bigdl_tpu.dataset.image import NativeBGRBatchDecoder
+        dec = NativeBGRBatchDecoder(224, 224, args.batchSize,
+                                    mean=(127.5,) * 3, std=(73.0,) * 3,
+                                    workers=args.workers)
+    else:
+        from bigdl_tpu.dataset.image import (BGRImgNormalizer, BytesToBGRImg,
+                                             MTLabeledBGRImgToBatch)
+        dec = MTLabeledBGRImgToBatch(
+            224, 224, args.batchSize,
+            transformer=(BytesToBGRImg(224, 224)
+                         >> BGRImgNormalizer(127.5, 73.0)),
+            workers=args.workers)
+    return ShardFolder.stream(args.shards) >> dec >> Prefetch(args.prefetch)
 
 
 def _cycle(make_iter):
@@ -147,6 +158,11 @@ def main(argv=None) -> None:
     ap.add_argument("--prefetch", type=int, default=2)
     ap.add_argument("--budget", type=float, default=60.0,
                     help="measurement budget (seconds) for read/decode")
+    ap.add_argument("--native", dest="native", action="store_true",
+                    default=True,
+                    help="whole-batch C++ decode (default)")
+    ap.add_argument("--no-native", dest="native", action="store_false",
+                    help="round-4 per-record MT Python decode (A/B)")
     ap.add_argument("--iterations", "-i", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=2)
     args = ap.parse_args(argv)

@@ -320,20 +320,22 @@ def generate_cmd(argv) -> None:
     tok = None
     if args.fromHF:
         from bigdl_tpu.interop.hf import load_hf_checkpoint
-        from bigdl_tpu.interop.hf_tokenizer import HFTokenizer
+        from bigdl_tpu.interop.hf_tokenizer import load_checkpoint_tokenizer
         model = load_hf_checkpoint(args.fromHF)
         if args.eosId is not None:
             args.eosId += 1  # the CLI eos under --fromHF is an HF id
-        if HFTokenizer.present_in(args.fromHF):
-            # checkpoint dir carries its tokenizer: --prompt is TEXT and
-            # encode/decode already speak framework 1-based ids
-            try:
-                tok = HFTokenizer.from_dir(args.fromHF)
-                print(f"loaded {tok!r} from the checkpoint dir; --prompt "
-                      "is text", file=sys.stderr)
-            except ValueError as e:  # unreadable (e.g. Llama SentencePiece)
-                print(f"checkpoint tokenizer not readable ({e}); falling "
-                      "back to raw HF ids", file=sys.stderr)
+        # checkpoint dir carries its tokenizer (GPT-2 byte-BPE json or
+        # Llama sentencepiece tokenizer.model): --prompt is TEXT and
+        # encode/decode already speak framework 1-based ids
+        try:
+            tok = load_checkpoint_tokenizer(args.fromHF)
+            print(f"loaded {tok!r} from the checkpoint dir; --prompt "
+                  "is text", file=sys.stderr)
+        except FileNotFoundError:
+            pass
+        except ValueError as e:  # present but unreadable
+            print(f"checkpoint tokenizer not readable ({e}); falling "
+                  "back to raw HF ids", file=sys.stderr)
         if tok is None:
             hf_shift = 1  # HF ids are 0-based; the framework's 1-based
     elif args.model:
@@ -413,6 +415,17 @@ def serve_cmd(argv) -> None:
                     help="serve the int8 weight-only quantized twin")
     ap.add_argument("--bf16", action="store_true",
                     help="serve the bf16 cast twin (decode latency knob)")
+    ap.add_argument("--continuous", action="store_true",
+                    help="slot-scheduled continuous batching (rope models "
+                    "only): mixed-length generations share the chip "
+                    "instead of lockstep same-length micro-batches")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="--continuous: concurrent generation slots")
+    ap.add_argument("--maxLen", type=int, default=256,
+                    help="--continuous: per-slot KV cache length "
+                    "(prompt + generation budget)")
+    ap.add_argument("--decodeBlock", type=int, default=8,
+                    help="--continuous: tokens decoded per dispatch")
     ap.add_argument("--tokenizer", default=None,
                     help="BPE tokenizer path: requests may then POST "
                     '{"text": ...} and responses include decoded text')
@@ -432,16 +445,17 @@ def serve_cmd(argv) -> None:
     tok = None
     if args.fromHF:
         from bigdl_tpu.interop.hf import load_hf_checkpoint
-        from bigdl_tpu.interop.hf_tokenizer import HFTokenizer
+        from bigdl_tpu.interop.hf_tokenizer import load_checkpoint_tokenizer
         model = load_hf_checkpoint(args.fromHF)
-        if HFTokenizer.present_in(args.fromHF):
-            try:
-                tok = HFTokenizer.from_dir(args.fromHF)
-                print(f"serving with {tok!r} from the checkpoint dir",
-                      file=sys.stderr)
-            except ValueError as e:  # unreadable: serve raw framework ids
-                print(f"checkpoint tokenizer not readable ({e}); clients "
-                      "must POST id prompts", file=sys.stderr)
+        try:
+            tok = load_checkpoint_tokenizer(args.fromHF)
+            print(f"serving with {tok!r} from the checkpoint dir",
+                  file=sys.stderr)
+        except FileNotFoundError:
+            pass
+        except ValueError as e:  # unreadable: serve raw framework ids
+            print(f"checkpoint tokenizer not readable ({e}); clients "
+                  "must POST id prompts", file=sys.stderr)
     elif args.model:
         model = file_io.load(args.model)
     else:
@@ -457,12 +471,22 @@ def serve_cmd(argv) -> None:
         tok = BPETokenizer.load(args.tokenizer)
     if tok is not None and args.eosId is None:
         args.eosId = tok.eos_id
-    server = LMServer(model, max_batch=args.maxBatch,
-                      batch_timeout_ms=args.batchTimeoutMs,
-                      max_new_tokens=args.maxNewTokens,
-                      temperature=args.temperature, top_k=args.topK,
-                      top_p=args.topP, greedy=args.greedy,
-                      eos_id=args.eosId, seed=args.seed)
+    if args.continuous:
+        from bigdl_tpu.models.serving import ContinuousLMServer
+        server = ContinuousLMServer(
+            model, slots=args.slots, max_len=args.maxLen,
+            decode_block=args.decodeBlock,
+            max_new_tokens=args.maxNewTokens,
+            temperature=args.temperature, top_k=args.topK,
+            top_p=args.topP, greedy=args.greedy,
+            eos_id=args.eosId, seed=args.seed)
+    else:
+        server = LMServer(model, max_batch=args.maxBatch,
+                          batch_timeout_ms=args.batchTimeoutMs,
+                          max_new_tokens=args.maxNewTokens,
+                          temperature=args.temperature, top_k=args.topK,
+                          top_p=args.topP, greedy=args.greedy,
+                          eos_id=args.eosId, seed=args.seed)
     httpd = make_http_server(server, args.host, args.port, tokenizer=tok)
     print(f"serving on http://{args.host}:{httpd.server_address[1]} "
           f"(POST /generate, GET /health)", file=sys.stderr)

@@ -12,7 +12,8 @@ from bigdl_tpu.interop.torch_file import load_torch, save_torch
 from bigdl_tpu.interop.caffe import CaffeLoader, load_caffe
 from bigdl_tpu.interop.state_dict import (export_lm_state_dict,
                                           import_lm_state_dict)
-from bigdl_tpu.interop.hf import (load_gpt2, load_llama, load_hf_checkpoint,
+from bigdl_tpu.interop.hf import (load_gpt2, load_llama, load_qwen2,
+                                  load_hf_checkpoint,
                                   save_hf_checkpoint,
                                   export_gpt2_state_dict,
                                   export_llama_state_dict,
@@ -20,6 +21,7 @@ from bigdl_tpu.interop.hf import (load_gpt2, load_llama, load_hf_checkpoint,
 
 __all__ = ["load_torch", "save_torch", "CaffeLoader", "load_caffe",
     "export_lm_state_dict", "import_lm_state_dict",
-    "load_gpt2", "load_llama", "load_hf_checkpoint", "save_hf_checkpoint",
+    "load_gpt2", "load_llama", "load_qwen2", "load_hf_checkpoint",
+    "save_hf_checkpoint",
     "export_gpt2_state_dict", "export_llama_state_dict",
     "to_framework_ids", "to_hf_ids"]

@@ -86,8 +86,9 @@ def gpt2_lm_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
     if not config.get("scale_attn_weights", True):
         raise ValueError("scale_attn_weights=False (unscaled attention) "
                          "is not mapped")
-    # "gelu" (exact erf) differs from our tanh-approx at ~1e-3; GPT-2
-    # proper is gelu_new, so accept and document rather than refuse
+    # "gelu" is the exact erf form; gelu_new/gelu_pytorch_tanh the tanh
+    # approximation (~1e-3 apart) — map each to its own kernel instead of
+    # silently substituting
     return dict(
         vocab_size=int(config["vocab_size"]),
         embed_dim=e,
@@ -97,7 +98,7 @@ def gpt2_lm_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
         max_len=int(config.get("n_positions", 1024)),
         pos="learned",
         tie_embeddings=True,
-        activation="gelu",
+        activation="gelu_exact" if act == "gelu" else "gelu",
         norm="layer",
         norm_eps=float(config.get("layer_norm_epsilon", 1e-5)),
     )
@@ -168,15 +169,15 @@ def llama_lm_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
     rope_scaling = None
     if scaling:
         rt = scaling.get("rope_type", scaling.get("type"))
-        if rt == "llama3":
-            # Llama-3.1 long-context frequency rescaling: implemented
-            # (nn.attention.llama3_scale_freqs, parity-tested)
+        if rt in ("llama3", "linear", "yarn"):
+            # implemented frequency rescalings (nn.attention
+            # .scale_rope_freqs, each parity-tested against transformers)
             rope_scaling = dict(scaling)
         elif rt != "default":
-            # other scalings (linear/dynamic/yarn) would silently change
-            # every attention score if ignored — refuse, don't corrupt
+            # the rest (dynamic NTK, longrope) would silently change every
+            # attention score if ignored — refuse, don't corrupt
             raise ValueError(f"rope_scaling {scaling!r} is not supported "
-                             "yet (plain or llama3 frequencies only)")
+                             "yet (plain/llama3/linear/yarn frequencies)")
     window = config.get("sliding_window")
     heads = int(config["num_attention_heads"])
     return dict(
@@ -225,6 +226,11 @@ def llama_state_dict_to_lm(hf_sd: Dict[str, Any],
             _np(sd[f"{src}.self_attn.q_proj.weight"]),
             _np(sd[f"{src}.self_attn.k_proj.weight"]),
             _np(sd[f"{src}.self_attn.v_proj.weight"])], axis=0)
+        if f"{src}.self_attn.q_proj.bias" in sd:  # Qwen2's qkv-bias layout
+            out[f"{dst}.self_attn.in_proj_bias"] = np.concatenate([
+                _np(sd[f"{src}.self_attn.q_proj.bias"]),
+                _np(sd[f"{src}.self_attn.k_proj.bias"]),
+                _np(sd[f"{src}.self_attn.v_proj.bias"])], axis=0)
         out[f"{dst}.self_attn.out_proj.weight"] = \
             _np(sd[f"{src}.self_attn.o_proj.weight"])
         out[f"{dst}.linear1.weight"] = _np(sd[f"{src}.mlp.gate_proj.weight"])
@@ -240,6 +246,52 @@ def load_llama(config: Dict[str, Any], state_dict: Dict[str, Any]) -> Module:
     model = build_lm(**kwargs)
     ours = llama_state_dict_to_lm(state_dict, kwargs["num_layers"])
     # tied checkpoints carry no lm_head.weight; untied must have it
+    strict = not kwargs["tie_embeddings"]
+    return import_lm_state_dict(model, ours, strict=strict)
+
+
+# -------------------------------------------------------------------- Qwen2
+
+def qwen2_lm_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
+    """``build_lm`` kwargs for an HF Qwen2 ``config.json`` dict — the
+    Llama block with biased q/k/v projections (and only those):
+    ``qkv_bias=True`` on our side restores exactly that layout."""
+    act = config.get("hidden_act", "silu")
+    if act != "silu":
+        raise ValueError(f"unsupported Qwen2 activation {act!r}")
+    # Qwen2's sliding_window key is inert unless use_sliding_window; when
+    # active, transformers applies it only to layers with index >=
+    # max_window_layers (so max_window_layers == num_hidden_layers — the
+    # shape real Qwen2 configs ship — means NO layer slides). We build
+    # homogeneous stacks: all-sliding (0) and none-sliding (== n_layers)
+    # map cleanly; a genuine mix is refused rather than corrupted.
+    window = None
+    if config.get("use_sliding_window", False):
+        n_layers = int(config["num_hidden_layers"])
+        mwl = int(config.get("max_window_layers", 0))
+        if mwl == 0:
+            window = int(config["sliding_window"])
+        elif mwl >= n_layers:
+            window = None  # sliding enabled but applies to no layer
+        else:
+            raise ValueError("Qwen2 mixed sliding-window layers "
+                             "(0 < max_window_layers < num_hidden_layers) "
+                             "are not mapped")
+    base = dict(config)
+    base.pop("sliding_window", None)  # handled above (llama semantics differ)
+    kwargs = llama_lm_kwargs(base)
+    kwargs["window"] = window
+    kwargs["qkv_bias"] = True
+    return kwargs
+
+
+def load_qwen2(config: Dict[str, Any], state_dict: Dict[str, Any]) -> Module:
+    """Build a ``build_lm`` model from an HF Qwen2 config + state_dict
+    (same tensor names as Llama plus q/k/v biases)."""
+    from bigdl_tpu.models.transformer import build_lm
+    kwargs = qwen2_lm_kwargs(config)
+    model = build_lm(**kwargs)
+    ours = llama_state_dict_to_lm(state_dict, kwargs["num_layers"])
     strict = not kwargs["tie_embeddings"]
     return import_lm_state_dict(model, ours, strict=strict)
 
@@ -355,6 +407,12 @@ def save_hf_checkpoint(model: Module, path: str) -> str:
     if not is_llama and act != "gelu":
         raise ValueError(f"GPT-2 export needs activation='gelu' "
                          f"(= HF gelu_new; model has {act!r})")
+    if is_llama and getattr(mha, "qkv_bias", False):
+        # Qwen2-shaped model: the llama export has no home for the q/k/v
+        # biases and a llama config would silently drop them
+        raise ValueError("Qwen2-family export (qkv_bias=True) is not "
+                         "implemented; a Llama config cannot carry the "
+                         "q/k/v projection biases")
     os.makedirs(path, exist_ok=True)
     if is_llama:
         sd = export_llama_state_dict(model)
@@ -411,15 +469,61 @@ def save_hf_checkpoint(model: Module, path: str) -> str:
 
 # ------------------------------------------------------------- directory I/O
 
+def _read_safetensors(fname: str) -> Dict[str, np.ndarray]:
+    """One safetensors file -> numpy dict. ``safetensors.numpy`` cannot
+    represent bfloat16 — the dominant dtype of real Llama/Mistral
+    checkpoints — so files containing non-numpy dtypes route through
+    ``safetensors.torch`` (``.float()``) with an ``ml_dtypes`` raw-buffer
+    fallback when torch is unavailable."""
+    import json as _json
+    import struct
+
+    with open(fname, "rb") as f:
+        (hdr_len,) = struct.unpack("<Q", f.read(8))
+        header = _json.loads(f.read(hdr_len))
+    numpy_ok = {"F64", "F32", "F16", "I64", "I32", "I16", "I8", "U8", "BOOL"}
+    dtypes = {m.get("dtype") for k, m in header.items()
+              if k != "__metadata__"}
+    if dtypes <= numpy_ok:
+        from safetensors.numpy import load_file
+        return dict(load_file(fname))
+    # wide-dtype path: parse the (trivial) wire format directly — header
+    # gives per-tensor dtype/shape/data_offsets into one contiguous buffer
+    import ml_dtypes
+    wide = {"BF16": ml_dtypes.bfloat16, "F8_E4M3": ml_dtypes.float8_e4m3fn,
+            "F8_E5M2": ml_dtypes.float8_e5m2}
+    np_map = {"F64": np.float64, "F32": np.float32, "F16": np.float16,
+              "I64": np.int64, "I32": np.int32, "I16": np.int16,
+              "I8": np.int8, "U8": np.uint8, "BOOL": np.bool_}
+    out = {}
+    with open(fname, "rb") as f:
+        base = 8 + hdr_len
+        for k, meta in header.items():
+            if k == "__metadata__":
+                continue
+            dt = meta["dtype"]
+            if dt in wide:
+                dtype, cast = wide[dt], np.float32
+            elif dt in np_map:
+                dtype, cast = np_map[dt], None
+            else:
+                raise ValueError(f"unsupported safetensors dtype {dt!r}")
+            start, stop = meta["data_offsets"]
+            f.seek(base + start)
+            arr = np.frombuffer(f.read(stop - start), dtype=dtype) \
+                .reshape(meta["shape"])
+            out[k] = arr.astype(cast) if cast is not None else arr
+    return out
+
+
 def _read_hf_weights(path: str) -> Dict[str, np.ndarray]:
     """Read an HF checkpoint directory's weights (safetensors preferred,
     single- or multi-shard; falls back to ``pytorch_model.bin``)."""
     st = [f for f in sorted(os.listdir(path)) if f.endswith(".safetensors")]
     if st:
-        from safetensors.numpy import load_file
         out: Dict[str, np.ndarray] = {}
         for f in st:
-            out.update(load_file(os.path.join(path, f)))
+            out.update(_read_safetensors(os.path.join(path, f)))
         return out
     bins = [f for f in sorted(os.listdir(path)) if f.endswith(".bin")
             and f.startswith("pytorch_model")]
@@ -446,4 +550,7 @@ def load_hf_checkpoint(path: str) -> Module:
         return load_gpt2(config, sd)
     if mt in ("llama", "mistral"):
         return load_llama(config, sd)
-    raise ValueError(f"unsupported model_type {mt!r} (gpt2/llama/mistral)")
+    if mt == "qwen2":
+        return load_qwen2(config, sd)
+    raise ValueError(
+        f"unsupported model_type {mt!r} (gpt2/llama/mistral/qwen2)")

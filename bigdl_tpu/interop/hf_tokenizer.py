@@ -190,3 +190,19 @@ class HFTokenizer:
     def __repr__(self):
         return (f"HFTokenizer(vocab={len(self.vocab)}, "
                 f"merges={len(self._ranks)})")
+
+
+def load_checkpoint_tokenizer(path: str):
+    """The ``--fromHF`` text dispatcher: GPT-2-style byte-level BPE
+    (``tokenizer.json``/``vocab.json``) via :class:`HFTokenizer`, else the
+    Llama-family SentencePiece ``tokenizer.model`` via
+    ``interop.sentencepiece`` — so both checkpoint families speak text end
+    to end. Raises ``FileNotFoundError`` when the directory carries no
+    known tokenizer, ``ValueError`` when one exists but is unreadable."""
+    from bigdl_tpu.interop.sentencepiece import SentencePieceTokenizer
+    if SentencePieceTokenizer.present_in(path):
+        return SentencePieceTokenizer.from_dir(path)
+    if HFTokenizer.present_in(path):
+        return HFTokenizer.from_dir(path)
+    raise FileNotFoundError(f"no tokenizer.model / tokenizer.json / "
+                            f"vocab.json+merges.txt in {path}")

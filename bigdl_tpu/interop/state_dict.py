@@ -86,7 +86,7 @@ def _named_params(model: Module) -> List[Tuple[str, Module, str]]:
         p = f"encoder.layers.{i}"
         attn: MultiHeadAttention = layer.self_attn
         out.append((f"{p}.self_attn.in_proj_weight", attn, "in_proj_weight"))
-        if attn.with_bias:
+        if attn.with_bias or getattr(attn, "qkv_bias", False):
             out.append((f"{p}.self_attn.in_proj_bias", attn, "in_proj_bias"))
         out.append((f"{p}.self_attn.out_proj.weight", attn,
                     "out_proj_weight"))

@@ -9,3 +9,5 @@ from bigdl_tpu.models import rnn
 from bigdl_tpu.models import transformer
 from bigdl_tpu.models import vit
 from bigdl_tpu.models.generation import generate, generate_speculative
+from bigdl_tpu.models.lm_server import LMServer, make_http_server
+from bigdl_tpu.models.serving import ContinuousLMServer

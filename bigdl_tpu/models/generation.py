@@ -475,13 +475,32 @@ def _set_decode_pos(buffers, value):
 def generate_speculative(target: Module, draft: Module, prompt,
                          max_new_tokens: int, *, spec_len: int = 4,
                          eos_id: Optional[int] = None,
-                         pad_id: Optional[int] = None) -> jax.Array:
-    """Greedy speculative decoding: the DRAFT proposes ``spec_len`` tokens
+                         pad_id: Optional[int] = None,
+                         key: Optional[jax.Array] = None,
+                         temperature: float = 1.0) -> jax.Array:
+    """Speculative decoding: the DRAFT proposes ``spec_len`` tokens
     per round, the TARGET verifies them in ONE chunked forward, and the
-    longest matching prefix is accepted plus the target's own next token
-    (the bonus) — so each round emits 1..spec_len+1 tokens for one target
-    dispatch. Output is EXACTLY the target's greedy generation (the draft
-    only changes speed, never tokens; differentially tested).
+    accepted prefix is emitted plus one target-sourced token — so each
+    round emits 1..spec_len+1 tokens for one target dispatch.
+
+    Two modes:
+
+    - ``key=None`` (default): GREEDY — the longest proposal prefix
+      matching the target's argmax is accepted plus the target's own next
+      token (the bonus). Output is EXACTLY the target's greedy generation
+      (the draft only changes speed, never tokens; differentially tested).
+    - ``key=PRNGKey``: SAMPLED — rejection-sampling speculative decoding
+      (Leviathan et al. / Chen et al.): proposals are drawn from the
+      draft distribution q, proposal i is accepted with probability
+      ``min(1, p_i(x)/q_i(x))`` against the target distribution p, the
+      first rejection resamples from the residual ``max(p - q, 0)``
+      (renormalized), and full acceptance samples the bonus from
+      ``p_{k+1}``. The emitted sequence is distributed EXACTLY as
+      sampling from the target alone — proven by the standard telescoping
+      argument and verified empirically by the distribution-matching test
+      (``tests/test_generation.py::TestSpeculativeSampled``).
+      ``temperature`` rescales BOTH distributions before proposal and
+      acceptance (the exactness theorem is per-distribution-pair).
 
     TPU-first mechanics: every round has STATIC shapes (the draft runs a
     fixed spec_len+1-step ``lax.scan`` — the +1 step writes the last
@@ -504,6 +523,9 @@ def generate_speculative(target: Module, draft: Module, prompt,
                          "lengths need per-row cache positions)")
     if spec_len < 1:
         raise ValueError("spec_len must be >= 1")
+    if temperature <= 0:
+        raise ValueError("temperature must be > 0")
+    sampled = key is not None
     k = int(spec_len)
     cap = s0 + max_new_tokens + k + 2  # cache slack for over-appended chunks
     if pad_id is None:
@@ -536,7 +558,15 @@ def generate_speculative(target: Module, draft: Module, prompt,
         d_params, d_bufs = draft.functional_state()
         t_heads, d_heads = t_mods[2], d_mods[2]
 
-        def run(t_params, t_bufs, d_params, d_bufs, prompt):
+        def _retemp(lp):
+            # log-probs -> temperature-rescaled log-probs (dividing
+            # log-probs by T differs from logits/T by a constant, which
+            # the renormalisation removes)
+            if temperature == 1.0:
+                return lp
+            return jax.nn.log_softmax(lp / temperature, axis=-1)
+
+        def run(t_params, t_bufs, d_params, d_bufs, prompt, rng):
             # prefill both models with SLICED heads ((B, 1, V) — the full
             # (B, S0, V) prefill log-probs are what head slicing exists to
             # avoid); the flags flip before the chunk phase is traced
@@ -545,7 +575,12 @@ def generate_speculative(target: Module, draft: Module, prompt,
                 m._decode_all = False
             t_out, t_bufs = functional_apply(target, t_params, t_bufs,
                                              prompt, training=False)
-            cur = jnp.argmax(t_out[:, -1], axis=-1).astype(jnp.int32) + 1
+            if sampled:
+                rng, k0 = jax.random.split(rng)
+                cur = jax.random.categorical(
+                    k0, _retemp(t_out[:, -1])).astype(jnp.int32) + 1
+            else:
+                cur = jnp.argmax(t_out[:, -1], axis=-1).astype(jnp.int32) + 1
             _, d_bufs = functional_apply(draft, d_params, d_bufs, prompt,
                                          training=False)
             for m in t_heads + d_heads:
@@ -558,27 +593,35 @@ def generate_speculative(target: Module, draft: Module, prompt,
             pos0 = jnp.int32(s0)
 
             def cond(carry):
-                _, _, _, count, _, done, _, _ = carry
+                _, _, _, count, _, done, _, _, _ = carry
                 return (count < max_new_tokens) & ~done[0]
 
             def body(carry):
-                t_bufs, d_bufs, out, count, cur, done, t_pos, d_pos = carry
+                t_bufs, d_bufs, out, count, cur, done, t_pos, d_pos, rng \
+                    = carry
+                rng, sub = jax.random.split(rng)
+                dkeys = jax.random.split(sub, k + 3)
 
                 # draft: k proposals + one extra step that writes the last
                 # proposal into the draft cache (full-acceptance support)
-                def dstep(c, _):
+                def dstep(c, step_key):
                     bufs, tok = c
                     lp, bufs = functional_apply(
                         draft, d_params, bufs,
                         tok[:, None].astype(prompt.dtype), training=False)
-                    nxt = jnp.argmax(lp[:, -1], axis=-1).astype(jnp.int32) + 1
-                    return (bufs, nxt), nxt
+                    q = _retemp(lp[:, -1])
+                    if sampled:
+                        nxt = jax.random.categorical(
+                            step_key, q).astype(jnp.int32) + 1
+                    else:
+                        nxt = jnp.argmax(q, axis=-1).astype(jnp.int32) + 1
+                    return (bufs, nxt), (nxt, q)
 
-                (d_bufs, _), d_toks = jax.lax.scan(
-                    dstep, (d_bufs, cur), None, length=k + 1)
+                (d_bufs, _), (d_toks, d_qs) = jax.lax.scan(
+                    dstep, (d_bufs, cur), dkeys[:k + 1])
                 d_toks = d_toks[:k, :, 0] if d_toks.ndim == 3 else d_toks[:k]
                 d_props = d_toks.T if d_toks.ndim == 2 else d_toks[None]
-                # d_props: (B, k)
+                # d_props: (B, k); d_qs: (k+1, B, V) draft log-probs
 
                 # target: one chunked verification forward over
                 # [cur, d_1..d_k] — logits for every position
@@ -586,15 +629,46 @@ def generate_speculative(target: Module, draft: Module, prompt,
                     [cur[:, None], d_props], axis=1).astype(prompt.dtype)
                 t_lp, t_bufs = functional_apply(target, t_params, t_bufs,
                                                 chunk, training=False)
+                t_lp = _retemp(t_lp)
                 g = jnp.argmax(t_lp, axis=-1).astype(jnp.int32) + 1
                 # g[:, i] = target's token after consuming chunk[:, :i+1]
 
-                # longest matching prefix of proposals
-                match = d_props == g[:, :k]            # (B, k)
-                n_acc = jnp.argmin(
-                    jnp.concatenate([match, jnp.zeros((b, 1), bool)],
-                                    axis=1), axis=1)[0]  # first mismatch
-                bonus = g[0, n_acc]
+                if sampled:
+                    # rejection sampling (exact target distribution):
+                    # accept proposal i iff u_i < p_i(x_i)/q_i(x_i)
+                    props0 = d_props[0] - 1                 # 0-based (k,)
+                    p_tok = jnp.take_along_axis(
+                        t_lp[0, :k], props0[:, None], 1)[:, 0]
+                    q_tok = jnp.take_along_axis(
+                        d_qs[:k, 0], props0[:, None], 1)[:, 0]
+                    us = jax.random.uniform(dkeys[k + 1], (k,))
+                    accept = jnp.log(us) < (p_tok - q_tok)
+                    n_acc = jnp.argmin(jnp.concatenate(
+                        [accept, jnp.zeros((1,), bool)])).astype(jnp.int32)
+                    # next token: residual max(p - q, 0) at the rejection
+                    # point; full acceptance (n_acc == k) samples the
+                    # bonus straight from p_{k+1} (residual with q = 0)
+                    t_row = jnp.exp(t_lp[0, n_acc])
+                    q_row = jnp.where(
+                        n_acc < k,
+                        jnp.exp(d_qs[jnp.minimum(n_acc, k - 1), 0]), 0.0)
+                    res = jnp.maximum(t_row - q_row, 0.0)
+                    tot = jnp.sum(res)
+                    # p == q exactly -> empty residual; the theorem's
+                    # conditional is then p itself
+                    probs = jnp.where(tot > 0, res / jnp.maximum(tot, 1e-38),
+                                      t_row)
+                    logits = jnp.where(probs > 0, jnp.log(
+                        jnp.maximum(probs, 1e-38)), -jnp.inf)
+                    bonus = jax.random.categorical(
+                        dkeys[k + 2], logits).astype(jnp.int32) + 1
+                else:
+                    # longest matching prefix of proposals
+                    match = d_props == g[:, :k]            # (B, k)
+                    n_acc = jnp.argmin(
+                        jnp.concatenate([match, jnp.zeros((b, 1), bool)],
+                                        axis=1), axis=1)[0]  # first mismatch
+                    bonus = g[0, n_acc]
                 # emitted this round: d_1..d_n, bonus  -> (k+1,) vector
                 emit = jnp.where(jnp.arange(k + 1) < n_acc,
                                  jnp.concatenate(
@@ -621,10 +695,11 @@ def generate_speculative(target: Module, draft: Module, prompt,
                 t_bufs = _set_decode_pos(t_bufs, t_pos)
                 d_bufs = _set_decode_pos(d_bufs, d_pos)
                 cur = bonus[None]
-                return (t_bufs, d_bufs, out, count, cur, done, t_pos, d_pos)
+                return (t_bufs, d_bufs, out, count, cur, done, t_pos, d_pos,
+                        rng)
 
             carry = (t_bufs, d_bufs, out0, jnp.int32(1), cur, done0,
-                     pos0, pos0)
+                     pos0, pos0, rng)
             carry = jax.lax.while_loop(cond, body, carry)
             out, count = carry[2], carry[3]
             # final mask: positions >= count -> pad; trim to max_new
@@ -634,7 +709,8 @@ def generate_speculative(target: Module, draft: Module, prompt,
                 [prompt, out.astype(prompt.dtype)], axis=1)
 
         cache = target.__dict__.setdefault("_spec_fns", {})
-        sig = (id(draft), b, s0, int(max_new_tokens), k, eos_id, pad_id)
+        sig = (id(draft), b, s0, int(max_new_tokens), k, eos_id, pad_id,
+               sampled, float(temperature))
         fn = cache.get(sig)
         if fn is None:
             if len(cache) >= 8:
@@ -644,7 +720,8 @@ def generate_speculative(target: Module, draft: Module, prompt,
                 cache.clear()
             fn = jax.jit(run)
             cache[sig] = fn
-        result = fn(t_params, t_bufs, d_params, d_bufs, prompt)
+        rng_in = key if sampled else jax.random.PRNGKey(0)
+        result = fn(t_params, t_bufs, d_params, d_bufs, prompt, rng_in)
     finally:
         for model, (mhas, pes, heads) in ((target, t_mods), (draft, d_mods)):
             for m in heads:

@@ -73,6 +73,11 @@ class LMServer:
         self._seed = seed
         self._n_batches = 0
         self._queue: "queue.Queue[_Request]" = queue.Queue()
+        # requests displaced from a batch because their length differed:
+        # consumed BEFORE the queue and in arrival order, so the next
+        # batch anchors on the OLDEST held request — a sustained stream of
+        # one length can no longer starve another (ADVICE round 4)
+        self._held: List[_Request] = []
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="lm-server-batcher")
@@ -105,6 +110,10 @@ class LMServer:
         self._worker.join(timeout=5)
         # fail anything still queued — a submit() blocked without timeout
         # must not hang forever on a server that will never decode again
+        for req in self._held:
+            req.error = "server closed before the request was dispatched"
+            req.done.set()
+        self._held = []
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -119,13 +128,29 @@ class LMServer:
 
     # ---------------------------------------------------------------- batcher
     def _gather(self) -> Optional[List[_Request]]:
-        """Oldest request + up-to-timeout same-length company."""
-        try:
-            first = self._queue.get(timeout=0.1)
-        except queue.Empty:
-            return None
-        batch, held = [first], []
+        """Oldest request + up-to-timeout same-length company.
+
+        The anchor is the oldest HELD request when one exists (held =
+        displaced from an earlier gather by length mismatch), so every
+        request's wait is bounded by the batches ahead of it at arrival —
+        strict arrival-order anchoring, no starvation."""
+        if self._held:
+            first = self._held.pop(0)
+        else:
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                return None
+        batch = [first]
         s = len(first.ids)
+        # same-length held company joins immediately (no timeout burn)
+        still_held = []
+        for req in self._held:
+            if len(req.ids) == s and len(batch) < self.max_batch:
+                batch.append(req)
+            else:
+                still_held.append(req)
+        self._held = still_held
         deadline = _now() + self.batch_timeout
         while len(batch) < self.max_batch:
             remaining = deadline - _now()
@@ -135,9 +160,7 @@ class LMServer:
                 req = self._queue.get(timeout=remaining)
             except queue.Empty:
                 break
-            (batch if len(req.ids) == s else held).append(req)
-        for req in held:  # different length: back on the queue, next batch
-            self._queue.put(req)
+            (batch if len(req.ids) == s else self._held).append(req)
         return batch
 
     def _run(self):

@@ -1,0 +1,330 @@
+"""Continuous batching LM serving engine (round 5, VERDICT #6).
+
+The bucketed ``models/lm_server.py`` groups requests by exact prompt
+length and decodes whole batches in lockstep: one long generation blocks
+its bucket, and mixed-length traffic fragments into tiny batches. This
+engine replaces lockstep with SLOTS (the vLLM-style iteration-level
+scheduler, built TPU-first on static shapes):
+
+- the model sits permanently in *continuous* decode mode: (slots, L) KV
+  caches with a PER-ROW ``decode_pos`` (``nn.attention
+  ._attend_decode_continuous``) — every slot lives at its own position in
+  its own sequence, and ONE jitted step program advances them all;
+- a new request prefills OUT-OF-BAND as a b=1 forward (one compile per
+  prompt length), then a jitted insert scatters its (1, L) cache into a
+  free slot row and sets that row's ``decode_pos`` — admission never
+  recompiles or disturbs running slots;
+- steps dispatch in blocks of ``decode_block`` tokens (a ``lax.scan`` —
+  amortizes the per-dispatch host cost); finished rows (eos/budget) free
+  their slot at the next block boundary and the queue admits strictly
+  FIFO, so no request can be starved (the ADVICE round-4 finding against
+  the bucketed ``_gather``).
+
+Dead slots keep computing garbage (their rows are never read) — the TPU
+trade: wasted lanes are cheaper than a recompile or a dynamic shape.
+
+Restrictions: rope models only (additive positional-encoding modules
+track a shared scalar position), no beam search. Sampling is the server's
+(greedy/temperature/top_k/top_p via ``generation.sample_token``).
+
+``ContinuousLMServer`` exposes the same ``submit()/close()`` surface as
+``LMServer``, so ``make_http_server`` and ``apps.transformer serve
+--continuous`` reuse it unchanged.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import functional_apply
+from bigdl_tpu.models.generation import _decode_modules, sample_token
+
+
+@dataclass
+class _Request:
+    ids: List[int]
+    max_new: int
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[List[int]] = None
+    error: Optional[str] = None
+
+
+class _Slot:
+    __slots__ = ("req", "emitted", "new_count")
+
+    def __init__(self, req):
+        self.req = req
+        self.emitted: List[int] = []
+        self.new_count = 0
+
+
+class ContinuousLMServer:
+    """Slot-scheduled continuous-batching server over one rope LM."""
+
+    def __init__(self, model, *, slots: int = 8, max_len: int = 256,
+                 decode_block: int = 8, max_new_tokens: int = 64,
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 0.0, greedy: bool = False,
+                 eos_id: Optional[int] = None, seed: int = 0):
+        if slots < 1:
+            raise ValueError("slots must be >= 1")
+        mhas, pes, heads = _decode_modules(model)
+        if pes:
+            raise ValueError(
+                "continuous batching requires a rope model (additive "
+                "positional encodings track one shared position; "
+                "build_lm(rope=True))")
+        if not mhas:
+            raise ValueError("model has no attention layers to cache")
+        self.model = model
+        self._mhas, self._heads = mhas, heads
+        self.slots = slots
+        self.max_len = max_len
+        self.decode_block = max(1, int(decode_block))
+        self.max_new_tokens = max_new_tokens
+        self.sampling = dict(temperature=temperature, top_k=top_k,
+                             top_p=top_p, greedy=greedy)
+        self.eos_id = eos_id
+        self._seed = seed
+        self._steps = 0
+        self._n_served = 0
+        self._n_admitted = 0
+
+        model.evaluate_mode()
+        # single-request decode template (the prefill signature) FIRST,
+        # then the persistent continuous state
+        for m in mhas:
+            m.enable_decode(1, max_len)
+        for m in heads:
+            m.enable_decode()
+        _, self._small_bufs0 = model.functional_state()
+        for m in mhas:
+            m.enable_decode(slots, max_len, continuous=True)
+        self.params, self.buffers = model.functional_state()
+        self._prefill_fns = {}
+        self._step_fn = None
+        self._insert_fn = None
+
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._stop = threading.Event()
+        self._free = list(range(slots))
+        self._active: dict = {}          # slot -> _Slot
+        self._last_tok = np.ones((slots,), np.int32)
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="lm-server-continuous")
+        self._worker.start()
+
+    # ------------------------------------------------------------ client API
+    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
+               timeout: Optional[float] = None) -> List[int]:
+        ids = [int(t) for t in prompt_ids]
+        if not ids:
+            raise ValueError("empty prompt")
+        max_new = int(self.max_new_tokens if max_new_tokens is None
+                      else max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(ids) + max_new > self.max_len:
+            raise ValueError(f"prompt {len(ids)} + max_new {max_new} "
+                             f"exceeds the server max_len {self.max_len}")
+        req = _Request(ids, max_new)
+        self._queue.put(req)
+        if not req.done.wait(timeout):
+            raise TimeoutError("decode did not complete in time")
+        if req.error is not None:
+            raise RuntimeError(req.error)
+        return req.result
+
+    def close(self):
+        self._stop.set()
+        self._worker.join(timeout=10)
+        for m in self._mhas + self._heads:
+            m.disable_decode()
+        for sl in self._active.values():
+            sl.req.error = "server closed mid-generation"
+            sl.req.done.set()
+        self._active.clear()
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = "server closed before the request was dispatched"
+            req.done.set()
+
+    @property
+    def batches_served(self) -> int:
+        return self._n_served
+
+    # ------------------------------------------------------------- programs
+    def _single_mode(self):
+        """Context: flip the attention modules to single-request decode
+        semantics for tracing/running the b=1 prefill program."""
+        server = self
+
+        class _Ctx:
+            def __enter__(self):
+                for m in server._mhas:
+                    m._continuous = False
+                    m._decode_prefilled = False
+                return self
+
+            def __exit__(self, *a):
+                for m in server._mhas:
+                    m._continuous = True
+                    m._decode_prefilled = True
+
+        return _Ctx()
+
+    def _prefill(self, plen: int):
+        """Jitted b=1 prompt prefill: (last log-probs, small buffers)."""
+        fn = self._prefill_fns.get(plen)
+        if fn is None:
+            model = self.model
+
+            def run(params, bufs, prompt):
+                lp, bufs = functional_apply(model, params, bufs, prompt,
+                                            training=False)
+                return lp[:, -1], bufs
+
+            fn = jax.jit(run)
+            self._prefill_fns[plen] = fn
+        return fn
+
+    def _insert(self):
+        """Jitted scatter of a prefilled b=1 cache into slot row ``slot``
+        (one compile total: slot/plen are traced scalars)."""
+        if self._insert_fn is None:
+            def run(big, small, slot, plen):
+                flat_b, treedef = jax.tree_util.tree_flatten_with_path(big)
+                flat_s = jax.tree_util.tree_flatten_with_path(small)[0]
+                out = []
+                for (kp, bg), (_, sm) in zip(flat_b, flat_s):
+                    name = str(kp[-1])
+                    if "k_cache" in name or "v_cache" in name:
+                        out.append(jax.lax.dynamic_update_slice(
+                            bg, sm.astype(bg.dtype),
+                            (slot,) + (0,) * (bg.ndim - 1)))
+                    elif "decode_pos" in name:
+                        out.append(jax.lax.dynamic_update_slice(
+                            bg, plen[None].astype(bg.dtype), (slot,)))
+                    else:
+                        out.append(bg)
+                return jax.tree_util.tree_unflatten(treedef, out)
+
+            self._insert_fn = jax.jit(run, donate_argnums=(0,))
+        return self._insert_fn
+
+    def _step(self):
+        """Jitted decode_block-token step over ALL slots."""
+        if self._step_fn is None:
+            model = self.model
+            sampling = self.sampling
+            block = self.decode_block
+
+            def run(params, bufs, toks, key):
+                def one(carry, kk):
+                    bufs, tok = carry
+                    lp, bufs = functional_apply(
+                        model, params, bufs,
+                        tok[:, None].astype(jnp.float32), training=False)
+                    nxt = sample_token(lp[:, -1], kk, **sampling)
+                    return (bufs, nxt), nxt
+
+                keys = jax.random.split(key, block)
+                (bufs, _), out = jax.lax.scan(one, (bufs, toks), keys)
+                return out.T, bufs      # (slots, block)
+
+            self._step_fn = jax.jit(run, donate_argnums=(1,))
+        return self._step_fn
+
+    # --------------------------------------------------------------- worker
+    def _admit(self, req: _Request) -> bool:
+        plen = len(req.ids)
+        try:
+            with self._single_mode():
+                prompt = jnp.asarray(np.asarray(req.ids, np.float32)[None])
+                lp, small = self._prefill(plen)(
+                    self.params, self._small_bufs0, prompt)
+            # key advances per ADMISSION (not per completion — several
+            # admits can happen between completions, and identical prompts
+            # sampled under a reused key would correlate perfectly)
+            self._n_admitted += 1
+            key = jax.random.PRNGKey(self._seed + self._n_admitted * 7919
+                                     + 1)
+            tok = int(sample_token(lp, key, **self.sampling)[0])
+            # peek, insert, THEN pop: an insert failure must not leak the
+            # slot. (The insert donates self.buffers; a RUNTIME failure
+            # mid-insert can still invalidate them — compile-time errors,
+            # the common case, happen before donation.)
+            slot = self._free[-1]
+            self.buffers = self._insert()(self.buffers, small,
+                                          jnp.int32(slot), jnp.int32(plen))
+            self._free.pop()
+            sl = _Slot(req)
+            sl.emitted = [tok]
+            sl.new_count = 1
+            self._last_tok[slot] = tok
+            if self._finish_if_done(slot, sl):
+                return True
+            self._active[slot] = sl
+            return True
+        except Exception as e:  # noqa: BLE001 — fail the one request
+            req.error = f"{type(e).__name__}: {e}"
+            req.done.set()
+            return False
+
+    def _finish_if_done(self, slot: int, sl: _Slot) -> bool:
+        eos = self.eos_id
+        hit_eos = eos is not None and sl.emitted and sl.emitted[-1] == eos
+        if hit_eos or sl.new_count >= sl.req.max_new:
+            sl.req.result = sl.emitted[:sl.req.max_new]
+            sl.req.done.set()
+            self._n_served += 1
+            if slot in self._active:
+                del self._active[slot]
+            self._free.append(slot)
+            return True
+        return False
+
+    def _run(self):
+        while not self._stop.is_set():
+            # strict-FIFO admission into free slots (starvation-free)
+            while self._free:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                self._admit(req)
+            if not self._active:
+                try:
+                    req = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                self._admit(req)
+                continue
+            # one decode block for every slot (dead rows compute garbage)
+            self._steps += 1
+            key = jax.random.PRNGKey(self._seed + self._steps * 31 + 17)
+            toks, self.buffers = self._step()(
+                self.params, self.buffers,
+                jnp.asarray(self._last_tok), key)
+            toks = np.asarray(toks)
+            self._last_tok = toks[:, -1].astype(np.int32)
+            eos = self.eos_id
+            for slot, sl in list(self._active.items()):
+                for t in toks[slot]:
+                    t = int(t)
+                    sl.emitted.append(t)
+                    sl.new_count += 1
+                    if ((eos is not None and t == eos)
+                            or sl.new_count >= sl.req.max_new):
+                        break
+                self._finish_if_done(slot, sl)

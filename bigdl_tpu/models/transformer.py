@@ -33,7 +33,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
              head_bias: Optional[bool] = None,
              norm_eps: Optional[float] = None,
              window: Optional[int] = None,
-             rope_scaling: Optional[dict] = None) -> nn.Sequential:
+             rope_scaling: Optional[dict] = None,
+             qkv_bias: bool = False) -> nn.Sequential:
     """Causal LM: 1-based token ids (N, T) -> log-probs (N, T, vocab).
 
     ``seq_axis="seq"`` shards every attention layer over the mesh sequence
@@ -96,7 +97,8 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
                                 num_kv_heads=num_kv_heads,
                                 rope_theta=rope_theta, bias=bias,
                                 norm_eps=norm_eps, window=window,
-                                rope_scaling=rope_scaling))
+                                rope_scaling=rope_scaling,
+                                qkv_bias=qkv_bias))
     if tie_embeddings:
         return m.add(nn.TiedLMHead(embed))
     hb = bias if head_bias is None else head_bias

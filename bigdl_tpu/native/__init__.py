@@ -16,6 +16,10 @@ native-adjacent:
 - ``bt_shard_scan`` — packed-shard index + multithreaded CRC verify, the
   bulk-ingest fast path (reference: Hadoop SequenceFile reading +
   ``MTLabeledBGRImgToBatch``'s multithreaded decode)
+- ``bt_decode_normalize`` — threaded whole-batch u8->f32 decode with fused
+  per-channel normalize (the decode half of
+  ``MTLabeledBGRImgToBatch.scala``; used by
+  ``dataset.image.NativeBGRBatchDecoder``)
 
 Bound via ctypes (no pybind11). The shared library is compiled lazily from
 ``src/*.cc`` with g++ on first import and cached next to the sources; if no
@@ -104,6 +108,10 @@ def _bind(path: str) -> ctypes.CDLL:
     dll.bt_shard_scan.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
                                   u64, u64, ctypes.c_size_t, ctypes.c_int]
     dll.bt_shard_scan.restype = ctypes.c_int64
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    dll.bt_decode_normalize.argtypes = [
+        u8, ctypes.c_int64, ctypes.c_int64, fp, fp, ctypes.c_int, fp,
+        ctypes.c_int]
     return dll
 
 

@@ -17,7 +17,7 @@ Compute-path notes (TPU-first):
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -111,7 +111,8 @@ class MultiHeadAttention(Module):
                  num_kv_heads: Optional[int] = None,
                  rope_theta: float = 10000.0,
                  window: Optional[int] = None,
-                 rope_scaling: Optional[dict] = None):
+                 rope_scaling: Optional[dict] = None,
+                 qkv_bias: bool = False):
         super().__init__()
         assert embed_dim % num_heads == 0, "embed_dim must divide num_heads"
         # window: sliding-window (banded causal) attention — query i sees
@@ -188,9 +189,14 @@ class MultiHeadAttention(Module):
         self.register_parameter(
             "out_proj_weight", init.xavier((embed_dim, embed_dim),
                                            embed_dim, embed_dim))
-        if with_bias:
+        # qkv_bias: bias on the q/k/v projections ONLY (Qwen2's layout:
+        # with_bias=False drops the out-proj + FFN biases, qkv_bias=True
+        # restores the input-projection one)
+        self.qkv_bias = qkv_bias
+        if with_bias or qkv_bias:
             self.register_parameter("in_proj_bias",
                                     init.zeros((embed_dim + 2 * e_kv,)))
+        if with_bias:
             self.register_parameter("out_proj_bias", init.zeros((embed_dim,)))
         self.attn_mask: Optional[jax.Array] = None
 
@@ -199,8 +205,13 @@ class MultiHeadAttention(Module):
     #: sliding window). Class attr for pickle forward-compat.
     _rolling = False
 
+    #: continuous-batching decode (per-row cache positions); class attr for
+    #: pickle forward-compat like _rolling
+    _continuous = False
+
     def enable_decode(self, batch_size: int, max_len: int,
-                      rolling: bool = False) -> "MultiHeadAttention":
+                      rolling: bool = False,
+                      continuous: bool = False) -> "MultiHeadAttention":
         """Switch to incremental-decode mode with a (B, max_len) KV cache.
 
         The cache and write position are registered BUFFERS, so under
@@ -215,7 +226,17 @@ class MultiHeadAttention(Module):
         O(window) regardless of generation length. Chunks attend the
         concatenation [ring, fresh k/v] BEFORE the ring is overwritten
         (an in-chunk write could destroy a slot an earlier chunk row still
-        needs), then the chunk's last ``window`` entries scatter in."""
+        needs), then the chunk's last ``window`` entries scatter in.
+
+        ``continuous=True`` (the serving engine's slot mode,
+        ``models/serving.py``): ``decode_pos`` becomes PER-ROW (B,) so
+        every batch row decodes at its own sequence position — mixed-length
+        generations share one program. Steps are single-token; prefill
+        happens out-of-band (the engine inserts a b=1 prefilled cache into
+        a slot row)."""
+        if rolling and continuous:
+            raise ValueError("continuous batching does not compose with "
+                             "the rolling ring cache yet")
         if self.seq_axis is not None:
             raise ValueError("decode mode is incompatible with "
                              "context-parallel attention (seq_axis)")
@@ -231,14 +252,18 @@ class MultiHeadAttention(Module):
         self._decode = True
         self._decode_prefilled = False
         self._rolling = rolling
+        self._continuous = continuous
         self.register_buffer("k_cache", jnp.zeros(shape, dt))
         self.register_buffer("v_cache", jnp.zeros(shape, dt))
-        self.register_buffer("decode_pos", jnp.zeros((), jnp.int32))
+        self.register_buffer(
+            "decode_pos",
+            jnp.zeros((batch_size,) if continuous else (), jnp.int32))
         return self
 
     def disable_decode(self) -> "MultiHeadAttention":
         self._decode = False
         self._rolling = False
+        self._continuous = False
         for name in ("k_cache", "v_cache", "decode_pos"):
             self._buffers.pop(name, None)
         return self
@@ -258,6 +283,8 @@ class MultiHeadAttention(Module):
         from bigdl_tpu.ops import attention_core
         if getattr(self, "_rolling", False):
             return self._attend_decode_rolling(q, k, v)
+        if getattr(self, "_continuous", False):
+            return self._attend_decode_continuous(q, k, v)
         pos = self.decode_pos
         self.k_cache = jax.lax.dynamic_update_slice(
             self.k_cache, k.astype(self.k_cache.dtype), (0, pos, 0, 0))
@@ -303,6 +330,49 @@ class MultiHeadAttention(Module):
         logits = (logits * (1.0 / float(d) ** 0.5)).astype(jnp.float32)
         valid = step_mask[0]  # (L,): causal (+ window band when set)
         logits = jnp.where(valid[None, None, None, :], logits,
+                           jnp.finfo(jnp.float32).min)
+        w = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("bkgl,blkd->bkgd", w.astype(self.v_cache.dtype),
+                         self.v_cache)
+        return ctx.reshape(b, 1, h, d)
+
+    def _attend_decode_continuous(self, q, k, v):
+        """Single-token step with PER-ROW cache positions (continuous
+        batching, ``models/serving.py``): row b writes its k/v at
+        ``decode_pos[b]`` and attends keys ``<= decode_pos[b]`` — every
+        slot lives at its own point in its own sequence. Prefill rows are
+        inserted out-of-band, so this path only ever sees s == 1."""
+        from bigdl_tpu.ops import attention_core
+        if q.shape[1] != 1:
+            raise ValueError("continuous decode steps are single-token "
+                             "(prefill is inserted per-slot by the engine)")
+        pos = self.decode_pos                                    # (B,)
+        bsz = q.shape[0]
+        rows = jnp.arange(bsz)
+        self.k_cache = self.k_cache.at[rows, pos].set(
+            k[:, 0].astype(self.k_cache.dtype))
+        self.v_cache = self.v_cache.at[rows, pos].set(
+            v[:, 0].astype(self.v_cache.dtype))
+        self.decode_pos = pos + 1
+        length = self.k_cache.shape[1]
+        k_pos = jnp.arange(length)[None, :]                      # (1, L)
+        valid = k_pos <= pos[:, None]                            # (B, L)
+        if getattr(self, "window", None):
+            valid = valid & (k_pos > pos[:, None] - self.window)
+        n_kv = self.k_cache.shape[2]
+        if n_kv == self.num_heads:
+            return attention_core.dot_product_attention(
+                q, self._expand_kv(self.k_cache),
+                self._expand_kv(self.v_cache),
+                mask=valid[:, None, None, :], causal=False)
+        # GQA grouped einsum (same shape trick as the steady-state path,
+        # with the per-row mask)
+        b, _, h, d = q.shape
+        g = h // n_kv
+        q_vec = q.reshape(b, n_kv, g, d)
+        logits = jnp.einsum("bkgd,blkd->bkgl", q_vec, self.k_cache)
+        logits = (logits * (1.0 / float(d) ** 0.5)).astype(jnp.float32)
+        logits = jnp.where(valid[:, None, None, :], logits,
                            jnp.finfo(jnp.float32).min)
         w = jax.nn.softmax(logits, axis=-1)
         ctx = jnp.einsum("bkgl,blkd->bkgd", w.astype(self.v_cache.dtype),
@@ -392,6 +462,29 @@ class MultiHeadAttention(Module):
         y = jnp.matmul(match_compute(x, w), w.T)
         return y + b if b is not None else y
 
+    def _in_projections(self, query, key, value):
+        """(q, k, v) pre-head-split — the quantized twin overrides this
+        (and ``_out_projection``) to run the fused int8 kernel on the raw
+        int8 row-slices instead of dequantizing the full matrix."""
+        e = self.embed_dim
+        ekv = getattr(self, "_e_kv", e)
+        w = self.in_proj_weight
+        wq, wk, wv = w[:e], w[e:e + ekv], w[e + ekv:]
+        if self.with_bias or getattr(self, "qkv_bias", False):
+            b = self.in_proj_bias
+            bq, bk, bv = b[:e], b[e:e + ekv], b[e + ekv:]
+        else:
+            bq = bk = bv = None
+        return (self._project(query, wq, bq), self._project(key, wk, bk),
+                self._project(value, wv, bv))
+
+    def _out_projection(self, ctx):
+        out = jnp.matmul(match_compute(ctx, self.out_proj_weight),
+                         self.out_proj_weight.T)
+        if self.with_bias:
+            out = out + self.out_proj_bias
+        return out
+
     def update_output(self, input):
         from bigdl_tpu.utils.table import Table
         mask = self.attn_mask
@@ -407,19 +500,10 @@ class MultiHeadAttention(Module):
             query = key = value = input
 
         e = self.embed_dim
-        ekv = getattr(self, "_e_kv", e)
-        wq, wk, wv = (self.in_proj_weight[:e],
-                      self.in_proj_weight[e:e + ekv],
-                      self.in_proj_weight[e + ekv:])
-        if self.with_bias:
-            bq, bk, bv = (self.in_proj_bias[:e],
-                          self.in_proj_bias[e:e + ekv],
-                          self.in_proj_bias[e + ekv:])
-        else:
-            bq = bk = bv = None
-        q = self._split_heads(self._project(query, wq, bq))
-        k = self._split_heads(self._project(key, wk, bk))
-        v = self._split_heads(self._project(value, wv, bv))
+        pq, pk, pv = self._in_projections(query, key, value)
+        q = self._split_heads(pq)
+        k = self._split_heads(pk)
+        v = self._split_heads(pv)
 
         if getattr(self, "rope", False):
             if k.shape[1] != q.shape[1]:
@@ -428,7 +512,11 @@ class MultiHeadAttention(Module):
                     "coincide); cross-attention inputs need per-tensor "
                     "positions")
             pos = jnp.arange(q.shape[1])
-            if self._decode:
+            if self._decode and getattr(self, "_continuous", False):
+                # per-row positions: (B, S) — each slot rotates at its own
+                # sequence point
+                pos = self.decode_pos[:, None] + pos[None, :]
+            elif self._decode:
                 pos = pos + self.decode_pos
             theta = getattr(self, "rope_theta", 10000.0)
             scaling = getattr(self, "rope_scaling", None)
@@ -443,11 +531,7 @@ class MultiHeadAttention(Module):
 
         b, s, _, _ = ctx.shape
         ctx = ctx.reshape(b, s, e)
-        out = jnp.matmul(match_compute(ctx, self.out_proj_weight),
-                         self.out_proj_weight.T)
-        if self.with_bias:
-            out = out + self.out_proj_bias
-        return out
+        return self._out_projection(ctx)
 
     def _attend(self, q, k, v, mask):
         from bigdl_tpu.ops import attention_core, flash_attention
@@ -584,7 +668,8 @@ class TransformerEncoderLayer(Module):
                  rope_theta: float = 10000.0, bias: bool = True,
                  norm_eps: Optional[float] = None,
                  window: Optional[int] = None,
-                 rope_scaling: Optional[dict] = None):
+                 rope_scaling: Optional[dict] = None,
+                 qkv_bias: bool = False):
         super().__init__()
         from bigdl_tpu.nn.linear import Linear
         from bigdl_tpu.nn.regularization import Dropout
@@ -597,7 +682,15 @@ class TransformerEncoderLayer(Module):
         # Context-parallel attention gets NO prob-dropout (its ring/Ulysses
         # cores use online softmax and never materialise probabilities);
         # the block's residual/FFN dropout still applies, so
-        # build_lm(dropout=..., seq_axis=...) stays constructible.
+        # build_lm(dropout=..., seq_axis=...) stays constructible. Warn so
+        # the regularization downgrade is visible (direct MHA with the same
+        # combination raises instead).
+        if seq_axis and dropout > 0.0:
+            import warnings
+            warnings.warn(
+                "TransformerEncoderLayer: attention-prob dropout is "
+                f"disabled under context parallelism (seq_axis={seq_axis!r}"
+                "); residual/FFN dropout still applies", stacklevel=2)
         self.self_attn = MultiHeadAttention(embed_dim, num_heads,
                                             dropout=(0.0 if seq_axis
                                                      else dropout),
@@ -611,7 +704,8 @@ class TransformerEncoderLayer(Module):
                                             rope_theta=rope_theta,
                                             with_bias=bias,
                                             window=window,
-                                            rope_scaling=rope_scaling)
+                                            rope_scaling=rope_scaling,
+                                            qkv_bias=qkv_bias)
         if moe_experts:
             if activation == "swiglu":
                 raise ValueError("swiglu FFN does not compose with MoE yet")
@@ -641,7 +735,9 @@ class TransformerEncoderLayer(Module):
 
     def _act(self, x):
         if self.activation == "gelu":
-            return jax.nn.gelu(x)
+            return jax.nn.gelu(x)  # tanh approximation (GPT-2's gelu_new)
+        if self.activation == "gelu_exact":
+            return jax.nn.gelu(x, approximate=False)  # erf form (HF "gelu")
         if self.activation == "relu":
             return jax.nn.relu(x)
         raise ValueError(f"unknown activation {self.activation!r}")
@@ -693,7 +789,8 @@ class TransformerEncoder(Module):
                  rope_theta: float = 10000.0, bias: bool = True,
                  norm_eps: Optional[float] = None,
                  window: Optional[int] = None,
-                 rope_scaling: Optional[dict] = None):
+                 rope_scaling: Optional[dict] = None,
+                 qkv_bias: bool = False):
         super().__init__()
         self.num_layers = num_layers
         for i in range(num_layers):
@@ -704,7 +801,8 @@ class TransformerEncoder(Module):
                 seq_layout=seq_layout, moe_experts=moe_experts, moe_k=moe_k,
                 rope=rope, norm=norm, num_kv_heads=num_kv_heads,
                 rope_theta=rope_theta, bias=bias, norm_eps=norm_eps,
-                window=window, rope_scaling=rope_scaling))
+                window=window, rope_scaling=rope_scaling,
+                qkv_bias=qkv_bias))
         if not pre_norm:
             self.final_norm = None
         elif norm == "rms":
@@ -757,6 +855,54 @@ def llama3_scale_freqs(freqs: jax.Array, scaling: dict) -> jax.Array:
     return (1.0 - smooth) * freqs / factor + smooth * freqs
 
 
+def scale_rope_freqs(freqs: jax.Array, theta: float,
+                     scaling: dict) -> Tuple[jax.Array, float]:
+    """(scaled_freqs, attention_scaling) for an HF ``rope_scaling`` dict.
+
+    - ``linear`` (position interpolation): every angle divided by
+      ``factor`` — equivalently freqs/factor.
+    - ``yarn``: NTK-by-parts — low frequencies interpolate (freqs/factor),
+      high frequencies extrapolate (unchanged), a linear ramp between the
+      ``beta_fast``/``beta_slow`` correction dims blends; cos/sin are
+      additionally scaled by ``attention_factor`` (default
+      ``0.1*ln(factor)+1``), matching HF ``_compute_yarn_parameters``.
+    - ``llama3``: wavelength-banded rescaling (``llama3_scale_freqs``).
+    """
+    rt = scaling.get("rope_type", scaling.get("type"))
+    if rt == "llama3":
+        return llama3_scale_freqs(freqs, scaling), 1.0
+    if rt == "linear":
+        return freqs / float(scaling["factor"]), 1.0
+    if rt == "yarn":
+        import math
+        factor = float(scaling["factor"])
+        attn = scaling.get("attention_factor")
+        if attn is None:
+            mscale = scaling.get("mscale")
+            attn = (0.1 * math.log(factor) + 1.0 if mscale is None
+                    else 0.1 * float(mscale) * math.log(factor) + 1.0)
+        beta_fast = float(scaling.get("beta_fast", 32.0))
+        beta_slow = float(scaling.get("beta_slow", 1.0))
+        orig = float(scaling.get("original_max_position_embeddings", 4096))
+        half = freqs.shape[0]
+        dim = 2 * half
+
+        def correction_dim(rot):
+            return (dim * math.log(orig / (rot * 2 * math.pi))
+                    / (2 * math.log(theta)))
+
+        low = math.floor(correction_dim(beta_fast))
+        high = math.ceil(correction_dim(beta_slow))
+        low, high = max(low, 0), min(high, dim - 1)
+        ramp = jnp.clip((jnp.arange(half, dtype=jnp.float32) - low)
+                        / max(high - low, 1e-3), 0.0, 1.0)
+        extrap_mask = 1.0 - ramp  # 1 where frequencies extrapolate
+        scaled = (freqs / factor) * (1.0 - extrap_mask) + freqs * extrap_mask
+        return scaled, float(attn)
+    raise ValueError(f"unsupported rope_scaling type {rt!r} "
+                     "(llama3/linear/yarn)")
+
+
 def rope_rotate(x: jax.Array, positions: jax.Array,
                 theta: float = 10000.0,
                 scaling: Optional[dict] = None) -> jax.Array:
@@ -775,11 +921,17 @@ def rope_rotate(x: jax.Array, positions: jax.Array,
     d = x.shape[-1]
     half = d // 2
     freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    att_scale = 1.0
     if scaling is not None:
-        freqs = llama3_scale_freqs(freqs, scaling)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (S, half)
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+        freqs, att_scale = scale_rope_freqs(freqs, theta, scaling)
+    positions = positions.astype(jnp.float32)
+    angles = positions[..., None] * freqs          # (S, half) or (B, S, half)
+    if angles.ndim == 2:                           # shared positions
+        angles = angles[None]
+    # attention_factor (yarn): HF multiplies cos/sin, scaling q and k each
+    # by it -> attention scores by its square
+    cos = jnp.cos(angles)[:, :, None, :] * att_scale
+    sin = jnp.sin(angles)[:, :, None, :] * att_scale
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)

@@ -1,0 +1,116 @@
+"""Fused int8-weight matmul Pallas kernel (round 5, VERDICT #5).
+
+Round 4 measured int8 weight-only decode at only 1.19x fp32 while the
+plain bf16 cast reached 1.69x: XLA lowers ``(q.astype(bf16) * scale) @ x``
+as a dequantize kernel that WRITES the bf16 weight to HBM and a matmul
+that reads it back — the int8 byte saving is spent twice. This kernel
+keeps the weight int8 all the way into VMEM:
+
+- grid (out_tiles, k_tiles), K innermost: the f32 output tile lives in
+  VMEM across the K sweep (one revisit chain), int8 weight tiles stream
+  HBM->VMEM at 1 byte/element;
+- the tile dequantizes IN REGISTERS (int8 -> bf16 is exact for |q|<=127),
+  feeds the MXU with bf16, accumulates f32;
+- the per-output-channel scale multiplies ONCE after the K sweep
+  (``(x @ q.T) * s == x @ (q*s).T`` exactly, since s is constant per
+  output row) — so the kernel is also numerically tighter than
+  dequantize-then-matmul.
+
+Decode (B=1) at real model sizes is weight-READ-bound (PERF.md round-4
+decode cost model), so halving resident bytes vs bf16 should approach 2x
+— the ``bench_int8`` harness in ``scripts/int8_decode_bench.py`` records
+the measured number.
+
+``int8_matmul`` falls back to the XLA dequant path off-TPU or for shapes
+the tiling doesn't divide; used by ``nn/quantized.py``'s Linear / LMHead /
+MultiHeadAttention twins.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# (TO, TK) weight tile: 64 KiB of int8; x/out tiles stay tiny for decode
+_TO = 256
+_TK = 256
+_M_PAD = 16  # bf16 sublane quantum
+
+
+def _kernel(x_ref, w_ref, s_ref, o_ref):
+    # whole-K block per output tile: one dot, no output revisits (a
+    # revisit-accumulate grid variant triggered a Mosaic compiler abort
+    # when embedded in large decode programs on this toolchain)
+    wt = w_ref[...].astype(jnp.bfloat16)            # int8 -> bf16 in-register
+    acc = jax.lax.dot_general(
+        x_ref[...], wt, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (M, TO) f32 on the MXU
+    o_ref[...] = acc * s_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _int8_matmul_pallas(x2, w_q, scale_row, interpret=False):
+    m, kdim = x2.shape
+    out_dim = w_q.shape[0]
+    no = out_dim // _TO
+    mp = max(_M_PAD, ((m + _M_PAD - 1) // _M_PAD) * _M_PAD)
+    xp = jnp.zeros((mp, kdim), jnp.bfloat16).at[:m].set(
+        x2.astype(jnp.bfloat16))
+    call = pl.pallas_call(
+        _kernel,
+        grid=(no,),
+        in_specs=[
+            pl.BlockSpec((mp, kdim), lambda i: (0, 0)),
+            pl.BlockSpec((_TO, kdim), lambda i: (i, 0)),
+            pl.BlockSpec((1, _TO), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((mp, _TO), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((mp, out_dim), jnp.float32),
+        interpret=interpret,
+    )
+    out = call(xp, w_q, scale_row.reshape(1, out_dim).astype(jnp.float32))
+    return out[:m]
+
+
+def kernel_applicable(m: int, kdim: int, out_dim: int) -> bool:
+    """Tiling gate: O must divide the output tile, K the lane quantum, and
+    the whole-K int8 weight block must fit VMEM comfortably. M is capped —
+    for big-M prefill/batch the weight read amortizes and XLA's path is
+    fine, while the kernel's fixed (M_pad, K) x-tile residency would
+    bloat."""
+    return (kdim % 128 == 0 and out_dim % _TO == 0 and m <= 256
+            and _TO * kdim <= 4 * 1024 * 1024)
+
+
+def int8_matmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
+                bias: Optional[jax.Array] = None,
+                compute_dtype=jnp.bfloat16) -> jax.Array:
+    """``y = x @ (w_q * scale).T (+ bias)`` with w_q int8 (O, K) and a
+    per-output-channel ``scale`` broadcastable to (O, 1). Dispatches to
+    the fused Pallas kernel on TPU when the tiling divides; XLA
+    dequant-then-matmul otherwise. Output in ``compute_dtype``."""
+    lead = x.shape[:-1]
+    kdim = x.shape[-1]
+    out_dim = w_q.shape[0]
+    x2 = x.reshape(-1, kdim)
+    m = x2.shape[0]
+    scale_row = jnp.asarray(scale).reshape(out_dim)
+    interpret = jax.default_backend() != "tpu"
+    if kernel_applicable(m, kdim, out_dim) and (not interpret or m <= 32):
+        # off-TPU the interpreter is slow — only worth it at test sizes
+        y = _int8_matmul_pallas(x2, w_q, scale_row, interpret=interpret)
+        y = y.astype(compute_dtype)
+    else:
+        w = w_q.astype(compute_dtype) * scale_row[:, None].astype(
+            compute_dtype)
+        y = jnp.matmul(x2.astype(compute_dtype), w.T)
+    if bias is not None:
+        # bias stays in ITS dtype (fp32 buffer): the add promotes the
+        # output to fp32, matching the unfused twins' numerics — logits
+        # argmax is sensitive to a bf16 downcast here
+        y = y + bias
+    return y.reshape(*lead, out_dim)

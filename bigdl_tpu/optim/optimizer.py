@@ -202,9 +202,16 @@ class Optimizer:
         self.validation_methods = list(v_methods)
         return self
 
-    def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
+    def set_checkpoint(self, path: str, trigger: Trigger,
+                       sharded: bool = False) -> "Optimizer":
+        """``sharded=True``: per-process shard files, no driver gather
+        (``utils/sharded_checkpoint.py``) — replaces the reference's
+        reassemble-on-driver snapshot (``DistriOptimizer.scala:378-400``)
+        for multi-host/FSDP states; restore reshards onto the resuming
+        run's mesh. Local filesystem paths only."""
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
+        self._ckpt_sharded = sharded
         return self
 
     def overwrite_checkpoint(self) -> "Optimizer":
@@ -368,11 +375,50 @@ class Optimizer:
         if self.checkpoint_path is None:
             return
         tag = "" if self.is_overwrite else f".{int(driver_state['neval'])}"
-        file_io.save({"params": params, "buffers": buffers},
-                     file_io.join(self.checkpoint_path, f"model{tag}"))
-        file_io.save({"optim": opt_state, "driver": dict(driver_state)},
-                     file_io.join(self.checkpoint_path, f"state{tag}"))
+        if getattr(self, "_ckpt_sharded", False):
+            import json as _json
+            from bigdl_tpu.utils import sharded_checkpoint as sckpt
+            sckpt.save_sharded(
+                file_io.join(self.checkpoint_path, f"model{tag}"),
+                {"params": params, "buffers": buffers})
+            state_dir = file_io.join(self.checkpoint_path, f"state{tag}")
+            sckpt.save_sharded(state_dir, {"optim": opt_state})
+            if jax.process_index() == 0:
+                driver = {k: (v.item() if hasattr(v, "item") else v)
+                          for k, v in dict(driver_state).items()}
+                with open(os.path.join(state_dir, "driver.json"), "w") as f:
+                    _json.dump(driver, f)
+        else:
+            file_io.save({"params": params, "buffers": buffers},
+                         file_io.join(self.checkpoint_path, f"model{tag}"))
+            file_io.save({"optim": opt_state, "driver": dict(driver_state)},
+                         file_io.join(self.checkpoint_path, f"state{tag}"))
         logger.info("[Checkpoint] saved model%s to %s", tag, self.checkpoint_path)
+
+    def _resume_shardings(self, params_tpl, buffers_tpl):
+        """Target shardings for a sharded-checkpoint resume: pytrees of
+        Sharding (or None = host numpy) matching (params, buffers,
+        opt_state). LocalOptimizer restores to host; DistriOptimizer
+        overrides to reshard onto its mesh."""
+        none_of = lambda tpl: jax.tree_util.tree_map(lambda _: None, tpl)
+        state_tpl = jax.eval_shape(self.optim_method.init_state, params_tpl)
+        return none_of(params_tpl), none_of(buffers_tpl), none_of(state_tpl)
+
+    def _load_sharded_checkpoint(self, model_path, state_path):
+        """(params, buffers, opt_state, driver) from per-shard files,
+        resharded onto this run's placement — possibly a different mesh
+        shape than the saving run's (``utils/sharded_checkpoint.py``)."""
+        import json as _json
+        from bigdl_tpu.utils import sharded_checkpoint as sckpt
+        params_tpl = self.model.parameter_tree()
+        buffers_tpl = self.model.buffer_tree()
+        p_sh, b_sh, s_sh = self._resume_shardings(params_tpl, buffers_tpl)
+        snap = sckpt.load_sharded(model_path,
+                                  {"params": p_sh, "buffers": b_sh})
+        st = sckpt.load_sharded(state_path, {"optim": s_sh})
+        with open(os.path.join(state_path, "driver.json")) as f:
+            driver = _json.load(f)
+        return snap["params"], snap["buffers"], st["optim"], driver
 
 
 class LocalOptimizer(Optimizer):
@@ -547,11 +593,17 @@ class LocalOptimizer(Optimizer):
 
         if resume:
             model_path, state_path = resume
-            snap = file_io.load(model_path)
-            params, buffers = snap["params"], snap["buffers"]
-            st = file_io.load(state_path)
-            opt_state = st["optim"]
-            driver_state.update(st["driver"])
+            from bigdl_tpu.utils import sharded_checkpoint as sckpt
+            if sckpt.is_sharded_checkpoint(model_path):
+                params, buffers, opt_state, driver = \
+                    self._load_sharded_checkpoint(model_path, state_path)
+                driver_state.update(driver)
+            else:
+                snap = file_io.load(model_path)
+                params, buffers = snap["params"], snap["buffers"]
+                st = file_io.load(state_path)
+                opt_state = st["optim"]
+                driver_state.update(st["driver"])
             logger.info("[Resume] from %s at epoch %s neval %s", model_path,
                         driver_state["epoch"], driver_state["neval"])
         else:

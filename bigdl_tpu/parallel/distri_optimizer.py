@@ -76,11 +76,11 @@ class DistriOptimizer(LocalOptimizer):
         self.topology = topology or MeshTopology.data_parallel()
         self.sync_mode = sync_mode
         self.compress_gradients = compress_gradients
-        if sync_mode == "sharded" and topology and any(
+        if sync_mode in ("sharded", "fsdp") and topology and any(
                 topology.sizes.get(ax, 1) > 1 for ax in ("tensor", "expert")):
-            raise ValueError("sync_mode='sharded' (ZeRO-1 flat slices) is a "
-                             "data-axis layout; combine tensor/expert "
-                             "parallelism with sync_mode='allreduce'")
+            raise ValueError(f"sync_mode={sync_mode!r} is a data-axis "
+                             "layout; combine tensor/expert parallelism "
+                             "with sync_mode='allreduce'")
         self.mesh: Mesh = self.topology.build()
         self._n_data = self.mesh.shape.get(DATA_AXIS, 1)
         self._n_tensor = self.mesh.shape.get(TENSOR_AXIS, 1)
@@ -167,6 +167,17 @@ class DistriOptimizer(LocalOptimizer):
     def _save_checkpoint(self, params, buffers, opt_state, driver_state):
         if self.checkpoint_path is None:
             return
+        if getattr(self, "_ckpt_sharded", False):
+            if self.sync_mode == "sharded":
+                raise ValueError(
+                    "set_checkpoint(sharded=True) is not supported with "
+                    "sync_mode='sharded' (ZeRO-1 state is device-count-"
+                    "shaped; its restore cannot reshard) — use 'fsdp' or "
+                    "'allreduce'")
+            # every process writes its own shards; no gather at all
+            super()._save_checkpoint(params, buffers, opt_state,
+                                     driver_state)
+            return
         if jax.process_count() > 1:
             fetch = lambda t: jax.tree_util.tree_map(self._fetch_host, t)
             # every process participates in the gather; only the 'driver'
@@ -176,6 +187,33 @@ class DistriOptimizer(LocalOptimizer):
             if jax.process_index() != 0:
                 return
         super()._save_checkpoint(params, buffers, opt_state, driver_state)
+
+    def _resume_shardings(self, params_tpl, buffers_tpl):
+        """Sharded-checkpoint restore targets for THIS run's mesh — which
+        may differ from the saving run's (the resharding-restore contract):
+        fsdp reshards params+state onto its specs; allreduce replicates.
+        sync_mode='sharded' (ZeRO-1) keeps flat padded state whose length
+        depends on the device count — unsupported for cross-mesh restore,
+        use the gathered checkpoint there."""
+        if self.sync_mode == "sharded":
+            raise ValueError(
+                "sharded checkpoints cannot restore into sync_mode="
+                "'sharded' (ZeRO-1 flat state is device-count-shaped); "
+                "use sync_mode='fsdp' or 'allreduce', or a plain "
+                "(gathered) checkpoint")
+        rep = self._replicated
+        state_tpl = jax.eval_shape(self.optim_method.init_state, params_tpl)
+        if self.sync_mode == "fsdp":
+            from bigdl_tpu.parallel.fsdp import fsdp_param_specs, named_tree
+            from bigdl_tpu.parallel.tensor_parallel import opt_state_specs
+            p_specs = fsdp_param_specs(params_tpl, self._n_data)
+            p_sh = named_tree(self.mesh, p_specs)
+            s_sh = named_tree(self.mesh, opt_state_specs(
+                state_tpl, params_tpl, p_specs))
+            b_sh = jax.tree_util.tree_map(lambda _: rep, buffers_tpl)
+            return p_sh, b_sh, s_sh
+        rep_of = lambda tpl: jax.tree_util.tree_map(lambda _: rep, tpl)
+        return rep_of(params_tpl), rep_of(buffers_tpl), rep_of(state_tpl)
 
     def _run_validation(self, params, buffers, fwd):
         """Multi-host: each process runs forward over ITS shard of the
@@ -232,6 +270,8 @@ class DistriOptimizer(LocalOptimizer):
     def _build_step(self) -> Callable:
         if self.sync_mode == "sharded":
             return self._build_sharded_step()
+        if self.sync_mode == "fsdp":
+            return self._build_fsdp_step()
         return self._build_allreduce_step()
 
     def _build_allreduce_step(self) -> Callable:
@@ -285,6 +325,55 @@ class DistriOptimizer(LocalOptimizer):
             step,
             in_shardings=(rep, rep, rep, rep, bat, bat),
             out_shardings=(rep, rep, rep, rep),
+            donate_argnums=(0, 1, 2))
+
+    def _build_fsdp_step(self) -> Callable:
+        """ZeRO-3: parameters + optimizer state sharded at rest over the
+        data axis (``parallel/fsdp.py``); XLA inserts the per-layer weight
+        all-gathers and the gradient reduce-scatter. Subsumes the
+        reference's slice-ownership protocol
+        (``parameters/AllReduceParameter.scala:62``) with the ownership
+        extended to the weights themselves."""
+        from bigdl_tpu.parallel.fsdp import fsdp_param_specs, named_tree
+        from bigdl_tpu.parallel.tensor_parallel import opt_state_specs
+
+        model, criterion, optim = self.model, self.criterion, self.optim_method
+        reg_pairs = _regularizer_pairs(model)
+        compress = self.compress_gradients
+        policy = self.precision
+        remat = self._remat
+        clip = make_grad_clipper(self._grad_clip)
+
+        params0 = model.parameter_tree()
+        p_specs = fsdp_param_specs(params0, self._n_data)
+        state_tpl = jax.eval_shape(optim.init_state, params0)
+        s_specs = opt_state_specs(state_tpl, params0, p_specs)
+        p_sh = named_tree(self.mesh, p_specs)
+        s_sh = named_tree(self.mesh, s_specs)
+        self._param_sharding = p_sh
+
+        def step(params, buffers, opt_state, rng, data, labels):
+            loss_fn = make_training_loss_fn(
+                model, criterion, policy, reg_pairs, remat,
+                buffers, rng, data, labels)
+
+            grads, (new_buf, loss) = jax.grad(loss_fn, has_aux=True)(params)
+            if compress:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.bfloat16).astype(g.dtype), grads)
+            # constrain grads to the param shardings: the backward's psum
+            # lowers to reduce-scatter (each device keeps its shard) instead
+            # of all-reduce + slice
+            grads = jax.lax.with_sharding_constraint(grads, p_sh)
+            new_params, new_opt_state = optim.update(clip(grads), opt_state,
+                                                     params)
+            return new_params, new_buf, new_opt_state, loss
+
+        rep, bat = self._replicated, self._batch_sharding
+        return jax.jit(
+            step,
+            in_shardings=(p_sh, rep, s_sh, rep, bat, bat),
+            out_shardings=(p_sh, rep, s_sh, rep),
             donate_argnums=(0, 1, 2))
 
     def _build_sharded_step(self) -> Callable:
@@ -385,7 +474,10 @@ class DistriOptimizer(LocalOptimizer):
             return out
 
         rep, bat = self._replicated, self._batch_sharding
-        return jax.jit(fwd, in_shardings=(rep, rep, bat), out_shardings=bat)
+        # fsdp: validation forward keeps the weights sharded too (XLA
+        # gathers per layer); _build_step runs first and records the specs
+        p_sh = getattr(self, "_param_sharding", rep)
+        return jax.jit(fwd, in_shardings=(p_sh, rep, bat), out_shardings=bat)
 
     # ------------------------------------------------------- optimizer state
     def _init_opt_state(self, params):

@@ -1,0 +1,100 @@
+"""B=1 decode bench: fp32 vs bf16-cast vs int8 fused-kernel weights
+(round 5, VERDICT #5 "done" evidence).
+
+Same 134M-param GQA target as the PERF.md round-4 decode table (E=768,
+L=12, H=12, KV=4, V=32K, rope/swiglu/rms), B=1, greedy. Timing is the
+slope method (two generation lengths differenced — cancels the tunnel
+RTT and the prefill cost; see roofline_pallas.py), after the standard
+clean-window calibration.
+
+Target: int8 >= 1.8x fp32 (the bf16 cast measured 1.69x in round 4; at
+the weight-read floor int8's 134 MB resident should approach 2x once the
+dequant never rematerializes — ops/int8_matmul.py).
+
+Usage: python scripts/int8_decode_bench.py [--tokens 128]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from roofline_pallas import _calibrate, _fetch  # noqa: E402
+
+
+def build_target():
+    from bigdl_tpu.models import transformer
+    from bigdl_tpu.utils.rng import manual_seed
+    manual_seed(7)
+    return transformer.build_lm(
+        32_000, embed_dim=768, num_heads=12, ffn_dim=3072, num_layers=12,
+        max_len=512, rope=True, activation="swiglu", norm="rms",
+        num_kv_heads=4, bias=False, tie_embeddings=True)
+
+
+def time_decode(model, n_small=16, n_large=None, iters=3):
+    """Seconds/token via the slope between two generation lengths."""
+    import jax.numpy as jnp
+    from bigdl_tpu.models.generation import generate
+
+    n_large = n_large or (n_small * 5)
+    prompt = jnp.ones((1, 8), jnp.float32)
+    ts = {}
+    for n in (n_small, n_large):
+        out = generate(model, prompt, n, greedy=True)  # compile + warmup
+        _fetch(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = generate(model, prompt, n, greedy=True)
+            _fetch(out)
+        ts[n] = (time.perf_counter() - t0) / iters
+    return (ts[n_large] - ts[n_small]) / (n_large - n_small)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=32,
+                    help="small chain length (large = 5x)")
+    ap.add_argument("--skip", default="", help="comma list: fp32,bf16,int8")
+    args = ap.parse_args()
+    skip = set(args.skip.split(","))
+
+    for _ in range(20):
+        cal, fixed = _calibrate()
+        print(json.dumps({"calibration_matmul_ms": round(cal, 1),
+                          "fixed_overhead_ms": round(fixed, 1)}), flush=True)
+        if cal < 12.0:
+            break
+        time.sleep(20)
+
+    from bigdl_tpu.nn.quantized import cast_model, quantize_model
+    model = build_target()
+    res = {}
+    variants = []
+    if "fp32" not in skip:
+        variants.append(("fp32", lambda: model))
+    if "bf16" not in skip:
+        variants.append(("bf16", lambda: cast_model(model)))
+    if "int8" not in skip:
+        variants.append(("int8", lambda: quantize_model(model)))
+    for name, mk in variants:
+        try:
+            spt = time_decode(mk(), n_small=args.tokens)
+            res[name] = {"tok_per_s": round(1.0 / spt, 1),
+                         "us_per_tok": round(spt * 1e6, 1)}
+        except Exception as e:  # noqa: BLE001
+            res[name] = {"error": str(e)[:300]}
+        print(json.dumps({name: res[name]}), flush=True)
+    if "fp32" in res and "tok_per_s" in res.get("fp32", {}):
+        for name in ("bf16", "int8"):
+            if "tok_per_s" in res.get(name, {}):
+                res[name]["vs_fp32"] = round(
+                    res[name]["tok_per_s"] / res["fp32"]["tok_per_s"], 2)
+    print(json.dumps({"int8_decode_bench": res}))
+
+
+if __name__ == "__main__":
+    main()

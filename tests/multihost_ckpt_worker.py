@@ -1,0 +1,81 @@
+"""Worker for the multi-host sharded-checkpoint test (not a pytest file).
+
+Usage: multihost_ckpt_worker.py <phase> <pid> <nproc> <port> <dir> <devs>
+
+Phase ``save``: each of the nproc processes (devs virtual CPU devices
+each) writes ITS shards of a tree laid out on an (nproc, devs) mesh —
+no process ever holds a full sharded leaf. Phase ``load``: a DIFFERENT
+process topology restores the checkpoint onto its own mesh and verifies
+every element (the save-on-2x4 / restore-on-4x2 contract,
+``utils/sharded_checkpoint.py``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    phase, pid, nproc, port, outdir = (sys.argv[1], int(sys.argv[2]),
+                                       int(sys.argv[3]), sys.argv[4],
+                                       sys.argv[5])
+    devs_per_proc = int(sys.argv[6])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devs_per_proc}")
+    os.environ["BIGDL_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["BIGDL_NUM_PROCESSES"] = str(nproc)
+    os.environ["BIGDL_PROCESS_ID"] = str(pid)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.sharded_checkpoint import load_sharded, save_sharded
+
+    Engine.init()
+    assert Engine.process_count() == nproc
+    n_dev = jax.device_count()
+    mesh = Mesh(np.array(jax.devices()).reshape(nproc, devs_per_proc),
+                ("a", "b"))
+
+    w = np.arange(16 * 24, dtype=np.float32).reshape(16, 24)
+    v = np.arange(8, dtype=np.float32) * 0.5
+    ck = os.path.join(outdir, "ck")
+
+    if phase == "save":
+        def put(host, spec):
+            sh = NamedSharding(mesh, spec)
+            return jax.make_array_from_callback(
+                host.shape, sh, lambda idx: host[idx])
+
+        tree = {"w": put(w, P("a", "b")), "v": put(v, P("a")),
+                "r": put(np.float32(2.5).reshape(()), P())}
+        save_sharded(ck, tree)
+        # each process holds only 1/nproc of w along dim 0
+        local = sum(s.data.size for s in tree["w"].addressable_shards
+                    if s.replica_id == 0)
+        assert local == w.size // nproc, (local, w.size)
+    else:
+        from jax.experimental import multihost_utils
+        out = load_sharded(ck, {
+            "w": NamedSharding(mesh, P("b", "a")),  # transposed layout
+            "v": NamedSharding(mesh, P("b")),
+            "r": NamedSharding(mesh, P()),
+        })
+        w_full = multihost_utils.process_allgather(out["w"], tiled=True)
+        v_full = multihost_utils.process_allgather(out["v"], tiled=True)
+        np.testing.assert_array_equal(w_full, w)
+        np.testing.assert_array_equal(v_full, v)
+        assert float(out["r"]) == 2.5
+        if jax.process_index() == 0:
+            with open(os.path.join(outdir, "load_ok"), "w") as f:
+                f.write("ok")
+    print(f"ckpt worker {phase} {pid}: done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -389,3 +389,42 @@ class TestFromHFTextServing:
         out = capsys.readouterr().out
         assert "prompt:       'the quick brown'" in out
         assert "continuation:" in out
+
+
+class TestFromHFLlamaSentencePiece:
+    """Llama-2-style checkpoint dirs (tokenizer.model, no tokenizer.json)
+    speak TEXT end-to-end — round 5's sentencepiece reader wired into the
+    --fromHF auto-load path."""
+
+    def test_generate_text_prompt_with_spm_tokenizer(self, capsys,
+                                                     tmp_path):
+        from bigdl_tpu.apps import transformer as app
+        from bigdl_tpu.interop.hf import save_hf_checkpoint
+        from bigdl_tpu.interop.sentencepiece import (BYTE, CONTROL, NORMAL,
+                                                     UNKNOWN, write_model)
+        from bigdl_tpu.models import transformer as tlib
+        import bigdl_tpu as bt
+
+        bt.utils.manual_seed(5)
+        pieces = [("<unk>", 0.0, UNKNOWN), ("<s>", 0.0, CONTROL),
+                  ("</s>", 0.0, CONTROL)]
+        pieces += [(f"<0x{b:02X}>", -100.0 - b * 1e-3, BYTE)
+                   for b in range(256)]
+        for i, w in enumerate(["▁the", "▁quick", "▁brown", "▁fox",
+                               "the", "quick", "fox", "▁"]):
+            pieces.append((w, -1.0 - 0.5 * i, NORMAL))
+        vocab = len(pieces)
+        model = tlib.build_lm(vocab, embed_dim=32, num_heads=2, ffn_dim=64,
+                              num_layers=1, max_len=64, rope=True,
+                              activation="swiglu", norm="rms",
+                              tie_embeddings=False)
+        hf_dir = str(tmp_path / "llama")
+        save_hf_checkpoint(model, hf_dir)
+        write_model(f"{hf_dir}/tokenizer.model", pieces,
+                    model_type="unigram", byte_fallback=True)
+        app.generate_cmd(["--fromHF", hf_dir,
+                          "--prompt", "the quick brown fox",
+                          "--maxNewTokens", "4", "--greedy"])
+        out = capsys.readouterr().out
+        assert "prompt:       'the quick brown fox'" in out
+        assert "continuation:" in out

@@ -193,3 +193,83 @@ class TestCastModel:
         b = np.asarray(generate(twin, p, 10, greedy=True))
         # bf16 rounding may flip near-tie argmaxes; require strong overlap
         assert (a == b).mean() > 0.7
+
+
+class TestInt8MatmulKernel:
+    """Fused int8 Pallas kernel (ops/int8_matmul.py, round 5): parity with
+    the XLA dequant-then-matmul path at tile-divisible shapes (interpret
+    mode off-TPU), gating, and module wiring."""
+
+    def _mats(self, m, k, o, seed=0):
+        rng = np.random.RandomState(seed)
+        x = rng.randn(m, k).astype(np.float32)
+        w = rng.randn(o, k).astype(np.float32) * 0.2
+        from bigdl_tpu.nn.quantized import quantize_array
+        q, s = quantize_array(jnp.asarray(w), 0)
+        return jnp.asarray(x), q, s
+
+    def test_kernel_matches_dequant_path(self):
+        from bigdl_tpu.ops.int8_matmul import (_int8_matmul_pallas,
+                                               int8_matmul,
+                                               kernel_applicable)
+        x, q, s = self._mats(4, 256, 512)
+        assert kernel_applicable(4, 256, 512)
+        got = np.asarray(_int8_matmul_pallas(
+            x, q, s.reshape(-1), interpret=True))
+        want = np.asarray(
+            jnp.matmul(x.astype(jnp.bfloat16),
+                       (q.astype(jnp.bfloat16)
+                        * s.astype(jnp.bfloat16)).T).astype(jnp.float32))
+        # kernel scales AFTER the accumulation (exact per-row commute), so
+        # it is a bit TIGHTER than dequant-then-matmul; bf16 matmul tol
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+    def test_kernel_matches_fp32_reference(self):
+        from bigdl_tpu.ops.int8_matmul import _int8_matmul_pallas
+        x, q, s = self._mats(2, 512, 256, seed=3)
+        got = np.asarray(_int8_matmul_pallas(
+            x, q, s.reshape(-1), interpret=True))
+        want = x @ (np.asarray(q, np.float32) * np.asarray(s)).T
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=3e-2)
+
+    def test_bias_and_lead_dims(self):
+        from bigdl_tpu.ops.int8_matmul import int8_matmul
+        x, q, s = self._mats(6, 256, 256, seed=5)
+        bias = jnp.arange(256, dtype=jnp.float32) * 0.01
+        y = int8_matmul(x.reshape(2, 3, 256), q, s, bias=bias)
+        assert y.shape == (2, 3, 256)
+        flat = int8_matmul(x, q, s, bias=bias)
+        np.testing.assert_array_equal(np.asarray(y).reshape(6, 256),
+                                      np.asarray(flat))
+
+    def test_indivisible_falls_back(self):
+        from bigdl_tpu.ops.int8_matmul import int8_matmul, kernel_applicable
+        x, q, s = self._mats(2, 100, 60, seed=7)
+        assert not kernel_applicable(2, 100, 60)
+        y = int8_matmul(x, q, s)  # XLA path, still correct
+        want = x @ (np.asarray(q, np.float32) * np.asarray(s)).T
+        np.testing.assert_allclose(np.asarray(y, np.float32), want,
+                                   rtol=2e-2, atol=3e-2)
+
+    def test_quantized_mha_matches_dequant_forward(self):
+        # the sliced-int8 projections must equal a forward through the
+        # dequantized full matrices (property path)
+        from bigdl_tpu.nn.quantized import quantize_module
+        from bigdl_tpu.utils.rng import manual_seed
+        manual_seed(3)
+        x = jnp.asarray(np.random.RandomState(1)
+                        .randn(2, 8, 256).astype(np.float32))
+        ref_q = quantize_module(
+            nn.MultiHeadAttention(256, 4, causal=True), jnp.bfloat16)
+        # copy quantized buffers into a comparable plain forward: dequant
+        # matrices through the property and run the BASE implementation
+        deq = nn.MultiHeadAttention(256, 4, causal=True)
+        deq._parameters["in_proj_weight"] = ref_q.in_proj_weight
+        deq._parameters["out_proj_weight"] = ref_q.out_proj_weight
+        deq._parameters["in_proj_bias"] = ref_q._buffers["in_proj_bias"]
+        deq._parameters["out_proj_bias"] = ref_q._buffers["out_proj_bias"]
+        deq.evaluate_mode()
+        ref_q.evaluate_mode()
+        got = np.asarray(ref_q.forward(x), np.float32)
+        want = np.asarray(deq.forward(x), np.float32)
+        np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)

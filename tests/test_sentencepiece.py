@@ -1,0 +1,199 @@
+"""SentencePiece reader parity (round 5, VERDICT #7).
+
+Oracle: the ``tokenizers`` library's Unigram/BPE implementations — the
+code HF fast tokenizers actually run for Llama-family models. A model is
+written through our own ModelProto serializer (``write_model``), read back
+by the torch-/sentencepiece-free reader, and every corpus string must
+produce ID-IDENTICAL output to a ``tokenizers`` pipeline built from the
+same vocab/scores (Metaspace pre-tokenization ≙ add_dummy_prefix +
+escape_whitespaces).
+"""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.interop.sentencepiece import (BYTE, CONTROL, NORMAL, UNKNOWN,
+                                             SentencePieceModel,
+                                             SentencePieceTokenizer,
+                                             write_model)
+
+# No leading-whitespace strings: true SentencePiece prepends the dummy
+# prefix unconditionally (what our reader does), while tokenizers'
+# Metaspace(prepend_scheme="first") skips it when text already starts
+# with whitespace — a known ecosystem divergence (the transformers
+# "legacy" tokenizer debate), orthogonal to segmentation correctness.
+CORPUS = [
+    "hello world",
+    "the quick brown fox jumps over the lazy dog",
+    "hello",
+    "leading and   internal   runs  ",
+    "punctuation, yes! and?",
+    "unknownXYZchars",
+    "café naïve 世界",   # accents + CJK -> byte fallback
+    "",
+    "a",
+    "wordwordword",
+]
+
+
+def _llama_style_pieces(byte_fallback=True):
+    """A tiny Llama-shaped unigram vocab: specials, byte pieces, then
+    scored word/subword pieces (all scores distinct to pin tie-breaking)."""
+    pieces = [("<unk>", 0.0, UNKNOWN), ("<s>", 0.0, CONTROL),
+              ("</s>", 0.0, CONTROL)]
+    if byte_fallback:
+        pieces += [(f"<0x{b:02X}>", -100.0 - b * 1e-3, BYTE)
+                   for b in range(256)]
+    words = ["▁hello", "▁world", "▁the", "▁quick",
+             "▁brown", "▁fox", "▁jump", "s", "▁over",
+             "▁lazy", "▁dog", "▁", "hello", "world", "wo",
+             "rld", "he", "llo", "▁word", "word", "w", "o", "r", "d",
+             "l", "a", "b", "c", "e", "punctuation", ",", "!", "?",
+             "▁punctuation", "▁and", "yes", "▁yes", "n",
+             "known", "un", "X", "Y", "Z", "chars", "▁unknown"]
+    for i, w in enumerate(words):
+        pieces.append((w, -1.0 - 0.25 * i, NORMAL))
+    return pieces
+
+
+def _tokenizers_unigram(pieces, unk_id=0, byte_fallback=True):
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    vocab = [(p, s) for p, s, _ in pieces]
+    tok = Tokenizer(models.Unigram(vocab, unk_id, byte_fallback))
+    tok.pre_tokenizer = pre_tokenizers.Metaspace(
+        replacement="▁", prepend_scheme="first")
+    tok.decoder = decoders.Metaspace(replacement="▁",
+                                     prepend_scheme="first")
+    return tok
+
+
+class TestUnigramParity:
+    @pytest.fixture(scope="class")
+    def pair(self, tmp_path_factory):
+        pieces = _llama_style_pieces()
+        path = str(tmp_path_factory.mktemp("spm") / "tokenizer.model")
+        write_model(path, pieces, model_type="unigram", byte_fallback=True)
+        ours = SentencePieceTokenizer.from_file(path)
+        ref = _tokenizers_unigram(pieces)
+        return ours, ref
+
+    @pytest.mark.parametrize("text", CORPUS)
+    def test_ids_match_tokenizers_lib(self, pair, text):
+        ours, ref = pair
+        got = [i - 1 for i in ours.encode(text)]     # framework -> spm ids
+        want = ref.encode(text).ids
+        assert got == want, (text, got, want)
+
+    @pytest.mark.parametrize("text", CORPUS)
+    def test_decode_round_trip(self, pair, text):
+        # write_model sets remove_extra_whitespaces=False (the Llama
+        # configuration), so decode(encode(x)) is lossless
+        ours, _ = pair
+        assert ours.decode(ours.encode(text)) == text
+
+    def test_unk_without_byte_fallback(self, tmp_path):
+        pieces = [("<unk>", 0.0, UNKNOWN), ("<s>", 0.0, CONTROL),
+                  ("</s>", 0.0, CONTROL), ("▁hi", -1.0, NORMAL)]
+        path = str(tmp_path / "tokenizer.model")
+        write_model(path, pieces, byte_fallback=False)
+        tok = SentencePieceTokenizer.from_file(path)
+        assert tok.encode("hi é") [:1] == [4]  # ▁hi (1-based)
+        assert tok.m.unk_id + 1 in tok.encode("hi é")
+
+
+class TestBpeParity:
+    def _bpe_setup(self, tmp_path):
+        # classic BPE: merges in priority order; SP-BPE stores priority as
+        # piece score (higher = earlier merge)
+        alphabet = ["▁", "a", "b", "c", "d", "e", "h", "l", "o", "r",
+                    "w"]
+        merges = [("h", "e"), ("l", "l"), ("he", "ll"), ("hell", "o"),
+                  ("▁", "hello"), ("w", "o"), ("r", "l"), ("wo", "rl"),
+                  ("worl", "d"), ("▁", "world"), ("a", "b"),
+                  ("ab", "c")]
+        vocab = {}
+        pieces = [("<unk>", 0.0, UNKNOWN), ("<s>", 0.0, CONTROL),
+                  ("</s>", 0.0, CONTROL)]
+        for ch in alphabet:
+            pieces.append((ch, -1000.0 - len(pieces), NORMAL))
+        for i, (a, b) in enumerate(merges):
+            pieces.append((a + b, -float(i), NORMAL))
+        path = str(tmp_path / "tokenizer.model")
+        write_model(path, pieces, model_type="bpe")
+        ours = SentencePieceTokenizer.from_file(path)
+
+        from tokenizers import Tokenizer, models, pre_tokenizers
+        tok_vocab = {p: i for i, (p, _, _) in enumerate(pieces)}
+        ref = Tokenizer(models.BPE(tok_vocab, merges, unk_token="<unk>"))
+        ref.pre_tokenizer = pre_tokenizers.Metaspace(
+            replacement="▁", prepend_scheme="first")
+        return ours, ref
+
+    @pytest.mark.parametrize("text", ["hello world", "abc", "hello",
+                                      "dcba", "world hello abc"])
+    def test_ids_match_tokenizers_lib(self, tmp_path, text):
+        ours, ref = self._bpe_setup(tmp_path)
+        got = [i - 1 for i in ours.encode(text)]
+        want = ref.encode(text).ids
+        assert got == want, (text, got, want)
+
+
+class TestModelProtoRoundTrip:
+    def test_flags_and_ids(self, tmp_path):
+        pieces = _llama_style_pieces()
+        path = str(tmp_path / "tokenizer.model")
+        write_model(path, pieces, model_type="unigram", byte_fallback=True,
+                    unk_id=0, bos_id=1, eos_id=2)
+        m = SentencePieceModel.from_file(path)
+        assert m.model_type == 1 and m.byte_fallback
+        assert (m.unk_id, m.bos_id, m.eos_id) == (0, 1, 2)
+        assert m.pieces[:3] == ["<unk>", "<s>", "</s>"]
+        assert m.types[3] == BYTE
+        tok = SentencePieceTokenizer(m)
+        assert tok.eos_id == 3 and tok.bos_id == 2  # 1-based
+        assert "unigram" in repr(tok)
+
+    def test_negative_pad_id_roundtrip(self, tmp_path):
+        # Llama ships pad_id=-1; proto negatives are 2^64-complement
+        from bigdl_tpu.visualization.proto import _varint_field, _len_field
+        pieces = [("<unk>", 0.0, UNKNOWN)]
+        path = str(tmp_path / "tokenizer.model")
+        write_model(path, pieces)
+        with open(path, "ab") as f:
+            f.write(_len_field(2, _varint_field(43, (1 << 64) - 1)))
+        m = SentencePieceModel.from_file(path)
+        assert m.pad_id == -1
+
+
+class TestDispatcher:
+    def test_prefers_sentencepiece_model(self, tmp_path):
+        from bigdl_tpu.interop.hf_tokenizer import load_checkpoint_tokenizer
+        write_model(str(tmp_path / "tokenizer.model"),
+                    _llama_style_pieces())
+        tok = load_checkpoint_tokenizer(str(tmp_path))
+        assert isinstance(tok, SentencePieceTokenizer)
+
+    def test_missing_raises(self, tmp_path):
+        from bigdl_tpu.interop.hf_tokenizer import load_checkpoint_tokenizer
+        with pytest.raises(FileNotFoundError):
+            load_checkpoint_tokenizer(str(tmp_path))
+
+
+class TestUnkFusing:
+    def test_consecutive_unknowns_fuse_to_one_unk(self):
+        # fuse_unk semantics (sentencepiece / HF tokenizers): a RUN of
+        # unknown characters is one <unk>, not one per character
+        from bigdl_tpu.interop.sentencepiece import (CONTROL, NORMAL,
+                                                     UNKNOWN)
+        pieces = [("<unk>", 0.0, UNKNOWN), ("<s>", 0.0, CONTROL),
+                  ("</s>", 0.0, CONTROL), ("▁hi", -1.0, NORMAL),
+                  ("▁", -2.0, NORMAL)]
+        import os
+        import tempfile
+        d = tempfile.mkdtemp()
+        p = os.path.join(d, "tokenizer.model")
+        write_model(p, pieces, byte_fallback=False)
+        tok = SentencePieceTokenizer.from_file(p)
+        ids = tok.encode("hi ééé")
+        # ▁hi, ▁, then ONE unk for the 3-char unknown run
+        assert ids == [4, 5, 1]
