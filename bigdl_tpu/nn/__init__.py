@@ -48,6 +48,7 @@ from bigdl_tpu.nn.normalization import (
     BatchNormalization, SpatialBatchNormalization, VolumetricBatchNormalization,
     SpatialCrossMapLRN, Normalize, SpatialSubtractiveNormalization,
     SpatialDivisiveNormalization, SpatialContrastiveNormalization,
+    InputNormalize,
 )
 from bigdl_tpu.nn.containers import (
     Container, Sequential, Concat, ConcatTable, ParallelTable, MapTable,

@@ -85,6 +85,34 @@ class VolumetricBatchNormalization(BatchNormalization):
     """Batch norm over (N, D, H, W, C)."""
 
 
+class InputNormalize(TensorModule):
+    """Device-side input normalization: cast the incoming batch (uint8
+    from the host decode path, or any dtype) to ``dtype`` and apply
+    per-channel ``(x - mean) / std``.
+
+    The TPU-first half of the ingest pipeline (round 5): the host ships
+    RAW uint8 batches — 4x fewer host->device bytes than f32, which on a
+    tunneled/PCIe-fed chip is the binding ingest constraint — and XLA
+    fuses the cast+normalize into the first convolution's input read.
+    Pairs with ``dataset.image.NativeBGRBatchDecoder(device_normalize=
+    True)``. No parameters; gradients pass through the affine map.
+    """
+
+    def __init__(self, mean, std, dtype=jnp.float32):
+        super().__init__()
+        self.mean = np.asarray(mean, np.float32)
+        self.rstd = 1.0 / np.asarray(std, np.float32)
+        self.dtype = dtype
+
+    def update_output(self, input):
+        x = input.astype(self.dtype)
+        return (x - jnp.asarray(self.mean, self.dtype)) \
+            * jnp.asarray(self.rstd, self.dtype)
+
+    def __repr__(self):
+        return f"InputNormalize(mean={self.mean}, std={1.0 / self.rstd})"
+
+
 class SpatialCrossMapLRN(TensorModule):
     """AlexNet-style local response normalization across channels
     (reference ``nn/SpatialCrossMapLRN.scala:235``).

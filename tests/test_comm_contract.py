@@ -110,10 +110,12 @@ def test_expert_parallel_step_routes_over_expert_axis():
 
     fn = jax.jit(jax.grad(loss), in_shardings=(p_sh, None, None))
     txt = fn.lower(params, buffers, x).compile().as_text()
+    # all-reduce deliberately NOT accepted: a replicated-weights
+    # regression would still emit one for the grad reduction; token
+    # routing shows up as data-movement collectives
     assert any(op in txt for op in
-               ("all-to-all", "all-gather", "collective-permute",
-                "all-reduce")), \
-        "EP step lowered with no cross-device communication at all"
+               ("all-to-all", "all-gather", "collective-permute")), \
+        "EP step lowered with no expert-axis data movement"
 
 
 def test_dp_tp_sp_regions_no_involuntary_rematerialization(capfd):

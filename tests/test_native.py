@@ -163,3 +163,38 @@ class TestDecodeNormalize:
         monkeypatch.setattr(native, "load", lambda *a, **k: None)
         without = next(iter(dec(iter(recs)))).data
         np.testing.assert_allclose(with_native, without, rtol=1e-6)
+
+
+class TestDeviceNormalizePath:
+    """u8 device-normalize ingest split (round 5): raw uint8 batches +
+    nn.InputNormalize on device must equal the host-normalized f32 path."""
+
+    def test_u8_batch_plus_input_normalize_matches_host_path(self):
+        import jax.numpy as jnp
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset.base import ByteRecord
+        from bigdl_tpu.dataset.image import NativeBGRBatchDecoder
+        rng = np.random.RandomState(6)
+        h = w = 4
+        recs = [ByteRecord(rng.randint(0, 256, h * w * 3, np.uint8)
+                           .tobytes(), 1.0) for _ in range(3)]
+        mean, std = (100.0, 120.0, 140.0), (50.0, 60.0, 70.0)
+        host = NativeBGRBatchDecoder(h, w, 3, mean, std)
+        dev = NativeBGRBatchDecoder(h, w, 3, mean, std,
+                                    device_normalize=True)
+        want = next(iter(host(iter(recs)))).data
+        raw = next(iter(dev(iter(recs)))).data
+        assert raw.dtype == np.uint8
+        norm = nn.InputNormalize(mean, std)
+        got = np.asarray(norm.forward(jnp.asarray(raw)))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_input_normalize_grad_passthrough(self):
+        import jax
+        import jax.numpy as jnp
+        from bigdl_tpu import nn
+        norm = nn.InputNormalize((1.0, 2.0, 3.0), (2.0, 4.0, 8.0))
+        x = jnp.ones((2, 2, 2, 3))
+        g = jax.grad(lambda x: jnp.sum(norm.forward(x)))(x)
+        np.testing.assert_allclose(
+            np.asarray(g), np.broadcast_to([0.5, 0.25, 0.125], g.shape))
