@@ -17,7 +17,15 @@ Field numbers (caffe.proto):
     BlobShape:         dim=1 (packed int64)
 
 Weight layouts: Caffe convolution blobs are (O, I/g, kH, kW) → converted to
-our HWIO; InnerProduct blobs are (out, in) → matches our Linear directly.
+our HWIO; Deconvolution blobs are (I, O/g, kH, kW); InnerProduct blobs are
+(out, in) → matches our Linear directly.
+
+Weight-copy coverage (round 5): Convolution, InnerProduct, Deconvolution,
+BatchNorm (with the scale_factor accumulator convention; gamma/beta live
+in caffe's separate Scale layer — mirror that structure with
+``SpatialBatchNormalization(affine=False)`` + ``nn.Scale``), Scale, PReLU,
+Embed. A name-matched, blob-carrying layer with no mapping raises instead
+of silently keeping random weights.
 """
 
 from __future__ import annotations
@@ -35,9 +43,11 @@ from bigdl_tpu.utils.protowire import (  # noqa: E402
     WT_I32 as _WT_I32, iter_fields as _iter_fields,
     read_varint as _read_varint)
 
-# V1LayerParameter.LayerType enum values used for weight-carrying layers
-_V1_TYPES = {4: "Convolution", 14: "InnerProduct", 39: "Deconvolution",
-             0: "None", 5: "Data", 18: "Pooling", 19: "Power", 33: "Scale"}
+# V1LayerParameter.LayerType enum values (caffe.proto V1 enum; the ones a
+# weight walk can encounter — others surface as their number)
+_V1_TYPES = {0: "None", 4: "Convolution", 5: "Data", 14: "InnerProduct",
+             17: "Pooling", 18: "ReLU", 19: "Sigmoid", 26: "Power",
+             39: "Deconvolution"}
 
 
 def _parse_blob(buf: memoryview) -> np.ndarray:
@@ -182,6 +192,64 @@ class CaffeLoader:
         if len(layer.blobs) > 1 and getattr(module, "with_bias", True):
             module.bias = jnp.asarray(layer.blobs[1].reshape(-1))
 
+    def _copy_deconv(self, module, layer: CaffeLayer) -> None:
+        import jax.numpy as jnp
+        w = layer.blobs[0]
+        if w.ndim != 4:  # caffe deconv blob: (I, O/g, kH, kW)
+            w = w.reshape(module.n_input_plane,
+                          module.n_output_plane // module.n_group,
+                          module.kh, module.kw)
+        # (I, O/g, kH, kW) -> ours (kH, kW, O/g, I)
+        module.weight = jnp.asarray(np.transpose(w, (2, 3, 1, 0)))
+        if len(layer.blobs) > 1 and getattr(module, "with_bias", True):
+            module.bias = jnp.asarray(layer.blobs[1].reshape(-1))
+
+    def _copy_batchnorm(self, module, layer: CaffeLayer) -> None:
+        """Caffe "BatchNorm": blobs = [mean, var, scale_factor]; the stored
+        statistics must be divided by the scalar scale_factor (caffe's
+        moving-average accumulator convention). Gamma/beta live in a
+        SEPARATE caffe "Scale" layer — build the model with
+        ``SpatialBatchNormalization(affine=False)`` followed by an
+        ``nn.Scale`` named after the caffe Scale layer, mirroring the
+        caffemodel's own two-layer structure."""
+        import jax.numpy as jnp
+        mean = layer.blobs[0].reshape(-1)
+        var = layer.blobs[1].reshape(-1)
+        sf = 1.0
+        if len(layer.blobs) > 2 and layer.blobs[2].size:
+            raw = float(layer.blobs[2].reshape(-1)[0])
+            sf = 0.0 if raw == 0.0 else 1.0 / raw
+        module.running_mean = jnp.asarray(mean * sf)
+        module.running_var = jnp.asarray(var * sf)
+
+    def _copy_scale(self, module, layer: CaffeLayer) -> None:
+        import jax.numpy as jnp
+        gamma = layer.blobs[0].reshape(-1)
+        module.cmul.weight = jnp.asarray(
+            gamma.reshape(module.cmul.weight.shape))
+        if len(layer.blobs) > 1:
+            beta = layer.blobs[1].reshape(-1)
+            module.cadd.bias = jnp.asarray(
+                beta.reshape(module.cadd.bias.shape))
+
+    def _copy_prelu(self, module, layer: CaffeLayer) -> None:
+        import jax.numpy as jnp
+        slopes = layer.blobs[0].reshape(-1)
+        module.weight = jnp.asarray(slopes.reshape(module.weight.shape))
+
+    def _copy_embed(self, module, layer: CaffeLayer) -> None:
+        import jax.numpy as jnp
+        if len(layer.blobs) > 1:
+            # caffe Embed defaults bias_term=true; LookupTable has no bias
+            # slot — refuse rather than silently drop the bias add
+            raise ValueError(
+                f"caffe Embed layer {layer.name!r} carries a bias blob; "
+                "LookupTable cannot represent it — follow the embedding "
+                "with nn.CAdd (named to a Scale/Bias layer) or retrain "
+                "with bias_term=false")
+        w = layer.blobs[0].reshape(module.n_index, module.n_output)
+        module.weight = jnp.asarray(w)
+
     def copy_parameters(self):
         from bigdl_tpu import nn
         layers: Dict[str, CaffeLayer] = {}
@@ -194,7 +262,9 @@ class CaffeLoader:
             if l.blobs or l.name not in layers:
                 layers[l.name] = l  # binary blobs win over text definition
         copied, missed = [], []
-        weighted = (nn.Linear, nn.SpatialConvolution, nn.SpaceToDepthConv7)
+        weighted = (nn.Linear, nn.SpatialConvolution, nn.SpaceToDepthConv7,
+                    nn.SpatialFullConvolution, nn.BatchNormalization,
+                    nn.Scale, nn.PReLU, nn.LookupTable)
         for name, module in self.model.named_modules():
             lname = module.get_name()
             layer = layers.get(lname)
@@ -218,8 +288,27 @@ class CaffeLoader:
             if isinstance(module, (nn.SpatialConvolution,
                                    nn.SpaceToDepthConv7)):
                 self._copy_conv(module, layer)
+            elif isinstance(module, nn.SpatialFullConvolution):
+                self._copy_deconv(module, layer)
             elif isinstance(module, nn.Linear):
                 self._copy_linear(module, layer)
+            elif isinstance(module, nn.BatchNormalization):
+                self._copy_batchnorm(module, layer)
+            elif isinstance(module, nn.Scale):
+                self._copy_scale(module, layer)
+            elif isinstance(module, nn.PReLU):
+                self._copy_prelu(module, layer)
+            elif isinstance(module, nn.LookupTable):
+                self._copy_embed(module, layer)
+            elif any(m._parameters for m in module.modules()):
+                # name-matched, blob-carrying, but no mapping: silently
+                # keeping random weights would corrupt — refuse. The scan
+                # covers SUBMODULE parameters too (composite modules like
+                # Scale keep theirs on children).
+                raise ValueError(
+                    f"caffe layer {lname!r} (type {layer.type!r}, "
+                    f"{len(layer.blobs)} blobs) matches parametric module "
+                    f"{type(module).__name__} with no weight mapping")
             else:
                 continue
             copied.append(lname)
