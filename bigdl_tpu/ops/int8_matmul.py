@@ -35,10 +35,20 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-# (TO, TK) weight tile: 64 KiB of int8; x/out tiles stay tiny for decode
-_TO = 256
-_TK = 256
+# Output-tile candidates, largest first: fewer grid steps = less per-step
+# overhead (measured: at 368M the 256-row tiling paid ~1200 grid steps per
+# decoded token and ran at half the weight-read roof). The weight block is
+# (TO, K) int8 and must stay well under VMEM with double buffering.
+_TO_CANDIDATES = (1024, 512, 256)
+_TILE_BYTES_CAP = 4 * 1024 * 1024
 _M_PAD = 16  # bf16 sublane quantum
+
+
+def _pick_to(out_dim: int, kdim: int) -> int:
+    for to in _TO_CANDIDATES:
+        if out_dim % to == 0 and to * kdim <= _TILE_BYTES_CAP:
+            return to
+    return 0
 
 
 def _kernel(x_ref, w_ref, s_ref, o_ref):
@@ -56,7 +66,8 @@ def _kernel(x_ref, w_ref, s_ref, o_ref):
 def _int8_matmul_pallas(x2, w_q, scale_row, interpret=False):
     m, kdim = x2.shape
     out_dim = w_q.shape[0]
-    no = out_dim // _TO
+    to = _pick_to(out_dim, kdim)
+    no = out_dim // to
     mp = max(_M_PAD, ((m + _M_PAD - 1) // _M_PAD) * _M_PAD)
     xp = jnp.zeros((mp, kdim), jnp.bfloat16).at[:m].set(
         x2.astype(jnp.bfloat16))
@@ -65,10 +76,10 @@ def _int8_matmul_pallas(x2, w_q, scale_row, interpret=False):
         grid=(no,),
         in_specs=[
             pl.BlockSpec((mp, kdim), lambda i: (0, 0)),
-            pl.BlockSpec((_TO, kdim), lambda i: (i, 0)),
-            pl.BlockSpec((1, _TO), lambda i: (0, i)),
+            pl.BlockSpec((to, kdim), lambda i: (i, 0)),
+            pl.BlockSpec((1, to), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((mp, _TO), lambda i: (0, i)),
+        out_specs=pl.BlockSpec((mp, to), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((mp, out_dim), jnp.float32),
         interpret=interpret,
     )
@@ -77,13 +88,13 @@ def _int8_matmul_pallas(x2, w_q, scale_row, interpret=False):
 
 
 def kernel_applicable(m: int, kdim: int, out_dim: int) -> bool:
-    """Tiling gate: O must divide the output tile, K the lane quantum, and
-    the whole-K int8 weight block must fit VMEM comfortably. M is capped —
-    for big-M prefill/batch the weight read amortizes and XLA's path is
-    fine, while the kernel's fixed (M_pad, K) x-tile residency would
-    bloat."""
-    return (kdim % 128 == 0 and out_dim % _TO == 0 and m <= 256
-            and _TO * kdim <= 4 * 1024 * 1024)
+    """Tiling gate: O must divide one of the output-tile candidates, K the
+    lane quantum, and the whole-K int8 weight block must fit VMEM
+    comfortably. M is capped — for big-M prefill/batch the weight read
+    amortizes and XLA's path is fine, while the kernel's fixed (M_pad, K)
+    x-tile residency would bloat."""
+    return (kdim % 128 == 0 and m <= 256
+            and _pick_to(out_dim, kdim) > 0)
 
 
 def int8_matmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
