@@ -87,13 +87,64 @@ class Dictionary:
 
 
 class SentenceSplitter(Transformer[str, List[str]]):
-    """Paragraph → sentences (reference ``SentenceSplitter``; regex here)."""
+    """Paragraph → sentences (reference ``SentenceSplitter``, which loads
+    a trained OpenNLP sentence model —
+    ``dataset/text/SentenceSplitter.scala``).
 
-    _SPLIT = re.compile(r"(?<=[.!?])\s+")
+    Rule-based here, with the standard model-free heuristics rather than
+    a bare ``[.!?]\\s`` split: a candidate boundary is REJECTED when the
+    period belongs to (a) a known abbreviation (titles, latinisms,
+    months, corporate suffixes), (b) a single-letter initial ("J. K.
+    Rowling"), (c) a decimal/ordinal number ("3.14", "No. 7"), or when
+    the following token starts lowercase (mid-sentence ellipsis or
+    abbreviation not in the list). Trailing quotes/brackets travel with
+    the closing sentence. Not OpenNLP-grade on adversarial prose, but
+    covers the failure modes a trained model is usually bought for."""
+
+    # Abbreviations that (almost) never END a sentence: a following
+    # capitalized word is still the same sentence ("Dr. Smith", "Jan. 5",
+    # "fig. 3"). Sentence-final-CAPABLE abbreviations (p.m., etc., Inc.)
+    # are deliberately NOT listed: for those the next-word-lowercase rule
+    # alone decides ("at 3 p.m. on" joins, "at 3 p.m. It" splits).
+    # ... and NOT ordinary English words (no/sat/sun/art/sec/gen/...):
+    # "He sat. The dog barked." must split, so an entry earns its place
+    # only when the bare word is rare as a sentence ender.
+    _ABBREV = {
+        "mr", "mrs", "ms", "dr", "prof", "rev", "sen",
+        "st", "e.g", "i.e", "cf", "vs", "dept", "fig",
+        "nos", "pp", "vol", "ch",
+        "jan", "feb", "apr", "jun", "jul", "aug", "sep",
+        "sept", "oct", "nov", "dec",
+    }
+    _CAND = re.compile(r"([.!?]+)([\"'”’)\]]*)\s+(?=\S)")
+
+    def _split_one(self, para: str) -> List[str]:
+        out, start = [], 0
+        for m in self._CAND.finditer(para):
+            end = m.end(2)
+            nxt = para[m.end():m.end() + 1]
+            if nxt.islower() and nxt.isalpha():
+                continue  # quote attribution / mid-sentence continuation
+            if m.group(1).endswith("."):
+                before = para[start:m.start(1)]
+                word = re.split(r"\s", before)[-1] if before else ""
+                token = word.rstrip(".").lstrip("(\"'“‘[").lower()
+                if (token in self._ABBREV
+                        or (len(token) == 1 and token.isalpha()
+                            and token not in ("i", "a"))):
+                    # abbreviation or single-letter initial — but the
+                    # words "I"/"a" end sentences ("So did I.")
+                    continue
+            out.append(para[start:end].strip())
+            start = m.end()
+        tail = para[start:].strip()
+        if tail:
+            out.append(tail)
+        return [s for s in out if s]
 
     def __call__(self, prev: Iterator[str]) -> Iterator[List[str]]:
         for para in prev:
-            yield [s for s in self._SPLIT.split(para.strip()) if s]
+            yield self._split_one(para.strip())
 
 
 class SentenceTokenizer(Transformer[str, List[str]]):
