@@ -93,9 +93,10 @@ class SentenceSplitter(Transformer[str, List[str]]):
 
     Rule-based here, with the standard model-free heuristics rather than
     a bare ``[.!?]\\s`` split: a candidate boundary is REJECTED when the
-    period belongs to (a) a known abbreviation (titles, latinisms,
-    months, corporate suffixes), (b) a single-letter initial ("J. K.
-    Rowling"), (c) a decimal/ordinal number ("3.14", "No. 7"), or when
+    period belongs to (a) a known never-sentence-final abbreviation
+    (titles, latinisms, months), (b) a single-letter initial ("J. K.
+    Rowling"), (c) a numeric reference ("No. 7", "sec. 3" — only when a digit
+    follows, so "The answer is no." still ends a sentence), or when
     the following token starts lowercase (mid-sentence ellipsis or
     abbreviation not in the list). Trailing quotes/brackets travel with
     the closing sentence. Not OpenNLP-grade on adversarial prose, but
@@ -116,6 +117,11 @@ class SentenceSplitter(Transformer[str, List[str]]):
         "jan", "feb", "apr", "jun", "jul", "aug", "sep",
         "sept", "oct", "nov", "dec",
     }
+    # Numeric-reference abbreviations: common English words that only act
+    # as abbreviations when a NUMBER follows ("No. 7", "sec. 3", "op. 9")
+    # — guarded by the next-char-is-digit check, so "The answer is no.
+    # We move on." still splits.
+    _NUM_REF = {"no", "p", "sec", "art", "op", "para", "pt"}
     _CAND = re.compile(r"([.!?]+)([\"'”’)\]]*)\s+(?=\S)")
 
     def _split_one(self, para: str) -> List[str]:
@@ -130,10 +136,12 @@ class SentenceSplitter(Transformer[str, List[str]]):
                 word = re.split(r"\s", before)[-1] if before else ""
                 token = word.rstrip(".").lstrip("(\"'“‘[").lower()
                 if (token in self._ABBREV
+                        or (token in self._NUM_REF and nxt.isdigit())
                         or (len(token) == 1 and token.isalpha()
                             and token not in ("i", "a"))):
-                    # abbreviation or single-letter initial — but the
-                    # words "I"/"a" end sentences ("So did I.")
+                    # abbreviation, numeric reference ("No. 7"), or
+                    # single-letter initial — but the words "I"/"a" end
+                    # sentences ("So did I.")
                     continue
             out.append(para[start:end].strip())
             start = m.end()

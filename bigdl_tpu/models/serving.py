@@ -104,7 +104,12 @@ class ContinuousLMServer:
             m.enable_decode(1, max_len)
         for m in heads:
             m.enable_decode()
-        _, self._small_bufs0 = model.functional_state()
+        _, small0 = model.functional_state()
+        # COPY the template leaves: non-cache buffers (e.g. a quantized
+        # model's int8 weights live in the buffer tree) are otherwise the
+        # very arrays the donating step/insert programs consume — the
+        # first admission would delete the prefill template's references
+        self._small_bufs0 = jax.tree_util.tree_map(jnp.copy, small0)
         for m in mhas:
             m.enable_decode(slots, max_len, continuous=True)
         self.params, self.buffers = model.functional_state()

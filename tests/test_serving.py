@@ -198,3 +198,24 @@ class TestBucketedStarvationFix:
         b3 = srv._gather()
         assert b3 == [reqs[3]]
         srv.close()
+
+
+class TestContinuousQuantizedCompose:
+    def test_int8_model_served_matches_int8_generate(self):
+        """serve --continuous --int8 composition: the slot engine over a
+        quantized twin must reproduce the quantized model's own greedy
+        generation (kernel path + per-row cache positions together)."""
+        from bigdl_tpu.nn.quantized import quantize_model
+        model, ref = _mk_model(7), _mk_model(7)
+        qm, qref = quantize_model(model), quantize_model(ref)
+        srv = ContinuousLMServer(qm, slots=2, max_len=32, greedy=True,
+                                 decode_block=4)
+        try:
+            for ids, mx in (([3, 9, 4], 6), ([5, 1, 2, 8, 7], 5)):
+                got = srv.submit(ids, mx, timeout=120)
+                want = np.asarray(generate(
+                    qref, jnp.asarray(np.asarray(ids, np.float32)[None]),
+                    mx, greedy=True))[0, len(ids):].astype(int).tolist()
+                assert got == want, ids
+        finally:
+            srv.close()

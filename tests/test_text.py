@@ -62,3 +62,18 @@ class TestTokenizeAndPad:
         assert padded[0] != padded[-1]  # start/end markers differ
         assert "hello" in padded and "world" in padded
 
+
+
+class TestNumericReferences:
+    @pytest.mark.parametrize("text,want", [
+        ("See No. 7 for details. The curve rises.",
+         ["See No. 7 for details.", "The curve rises."]),
+        ("Read sec. 3 first. Then continue.",
+         ["Read sec. 3 first.", "Then continue."]),
+        ("The answer is no. We move on.",
+         ["The answer is no.", "We move on."]),
+        ("Op. 9 is famous. He wrote it.",
+         ["Op. 9 is famous.", "He wrote it."]),
+    ], ids=["No7", "sec3", "plain-no", "op9"])
+    def test_digit_guarded_abbrevs(self, text, want):
+        assert _split(text) == want
