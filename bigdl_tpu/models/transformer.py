@@ -58,8 +58,10 @@ def build_lm(vocab_size: int, embed_dim: int = 128, num_heads: int = 4,
 
     ``rope=True`` replaces the additive sinusoidal PositionalEncoding with
     rotary embeddings on q/k (relative positions; the modern standard) —
-    the PE module is dropped entirely. Not yet composable with
-    ``seq_axis`` context parallelism.
+    the PE module is dropped entirely. Composes with ``seq_axis`` context
+    parallelism (round 5): each shard rotates at its GLOBAL positions
+    (contiguous or zigzag ring layout, Ulysses) — the long-context Llama
+    training recipe.
 
     ``activation="swiglu"`` + ``norm="rms"`` + ``rope=True`` +
     ``tie_embeddings=True`` is the Llama-family block recipe — every

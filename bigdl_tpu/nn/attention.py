@@ -147,9 +147,6 @@ class MultiHeadAttention(Module):
         # keys carry their rotation and the q@k score is relative.
         if rope and (embed_dim // num_heads) % 2 != 0:
             raise ValueError("rope needs an even head_dim")
-        if rope and seq_axis is not None:
-            raise ValueError("rope is not supported with context-parallel "
-                             "attention yet (per-shard global positions)")
         self.rope = rope
         self.rope_theta = rope_theta
         # Llama-3.1-style "llama3" frequency rescaling dict (None = plain)
@@ -162,6 +159,10 @@ class MultiHeadAttention(Module):
         # sequence with context.zigzag_permutation before sharding.
         self.seq_axis = seq_axis
         self.seq_mode = seq_mode
+        if seq_axis is not None and seq_layout == "zigzag" \
+                and seq_mode != "ring":
+            raise ValueError("seq_layout='zigzag' is a ring-attention "
+                             "layout; ulysses shards contiguously")
         self.seq_layout = seq_layout
         self.embed_dim = embed_dim
         self.num_heads = num_heads
@@ -518,6 +519,18 @@ class MultiHeadAttention(Module):
                 pos = self.decode_pos[:, None] + pos[None, :]
             elif self._decode:
                 pos = pos + self.decode_pos
+            elif self.seq_axis is not None:
+                # context parallelism: this module sees a SHARD of the
+                # sequence inside shard_map; rotations must use GLOBAL
+                # positions (the long-context Llama recipe — ring/Ulysses
+                # attention cores are position-agnostic, rope is not)
+                idx = jax.lax.axis_index(self.seq_axis)
+                if self.seq_layout == "zigzag":
+                    from bigdl_tpu.parallel.context import _zigzag_positions
+                    pos = _zigzag_positions(
+                        idx, q.shape[1], jax.lax.axis_size(self.seq_axis))
+                else:
+                    pos = idx * q.shape[1] + pos
             theta = getattr(self, "rope_theta", 10000.0)
             scaling = getattr(self, "rope_scaling", None)
             q = rope_rotate(q, pos, theta, scaling)

@@ -220,3 +220,85 @@ def test_zigzag_permutation_balance():
     chunk = s // p
     work = [(perm[i * chunk:(i + 1) * chunk] + 1).sum() for i in range(p)]
     assert max(work) - min(work) <= chunk  # contiguous layout spread: ~s*chunk
+
+
+class TestRopeContextParallel:
+    """RoPE + context parallelism (round 5): rotations must use GLOBAL
+    positions per shard — the long-context Llama recipe. Oracle: the
+    identical-weights unsharded rope encoder."""
+
+    def _encoders(self, mode, layout):
+        from bigdl_tpu import nn
+        from bigdl_tpu.utils.rng import manual_seed
+        heads = 8 if mode == "ulysses" else 2  # ulysses: heads % P == 0
+        manual_seed(17)
+        sharded = nn.TransformerEncoder(
+            2, 16, heads, 32, causal=True, rope=True, norm="rms",
+            activation="swiglu", seq_axis="seq", seq_mode=mode,
+            seq_layout=layout)
+        manual_seed(17)
+        plain = nn.TransformerEncoder(
+            2, 16, heads, 32, causal=True, rope=True, norm="rms",
+            activation="swiglu")
+        return sharded, plain
+
+    @pytest.mark.parametrize("mode,layout", [
+        ("ring", "contiguous"), ("ring", "zigzag"),
+        ("ulysses", "contiguous")])
+    def test_forward_and_grad_match_unsharded(self, mode, layout):
+        from jax import shard_map as _sm
+        from jax.sharding import PartitionSpec as P
+        from bigdl_tpu.nn.module import functional_apply
+        from bigdl_tpu.parallel.context import (zigzag_inverse,
+                                                zigzag_permutation)
+
+        p = 8
+        b, s, e = 2, 32, 16
+        sharded, plain = self._encoders(mode, layout)
+        params, buffers = sharded.parameter_tree(), sharded.buffer_tree()
+        mesh = _mesh(p)
+        x = _rand(b, s, e)
+
+        if layout == "zigzag":
+            perm = jnp.asarray(zigzag_permutation(s, p))
+            inv = jnp.asarray(zigzag_inverse(s, p))
+            x_in = x[:, perm]
+        else:
+            x_in = x
+
+        def fwd(pr, bf, xx):
+            y, _ = functional_apply(sharded, pr, bf, xx, training=False)
+            return y
+
+        sharded_fwd = jax.jit(_sm(
+            fwd, mesh=mesh, in_specs=(P(), P(), P(None, "seq", None)),
+            out_specs=P(None, "seq", None), check_vma=False))
+        got = sharded_fwd(params, buffers, x_in)
+        if layout == "zigzag":
+            got = got[:, inv]
+        want, _ = functional_apply(plain, params, buffers, x,
+                                   training=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-4, atol=3e-4)
+
+        # grads through the sharded rope path
+        def loss_sharded(pr):
+            y = sharded_fwd(pr, buffers, x_in)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        def loss_plain(pr):
+            y, _ = functional_apply(plain, pr, buffers, x, training=False)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        g_s = jax.grad(loss_sharded)(params)
+        g_p = jax.grad(loss_plain)(params)
+        for a, b_ in zip(jax.tree_util.tree_leaves(g_s),
+                         jax.tree_util.tree_leaves(g_p)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=5e-4, atol=5e-4)
+
+    def test_zigzag_with_ulysses_refused(self):
+        from bigdl_tpu import nn
+        with pytest.raises(ValueError, match="zigzag"):
+            nn.MultiHeadAttention(16, 8, seq_axis="seq",
+                                  seq_mode="ulysses", seq_layout="zigzag")
