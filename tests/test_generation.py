@@ -497,8 +497,9 @@ class TestRope:
         from bigdl_tpu.nn.attention import MultiHeadAttention
         with pytest.raises(ValueError, match="even head_dim"):
             MultiHeadAttention(6, 2, rope=True)  # head_dim 3
-        with pytest.raises(ValueError, match="context-parallel"):
-            MultiHeadAttention(16, 2, rope=True, seq_axis="seq")
+        # rope + seq_axis COMPOSES since round 5 (per-shard global
+        # positions) — constructible; parity in test_context_parallel
+        MultiHeadAttention(16, 2, rope=True, seq_axis="seq")
 
     def test_rope_cross_attention_rejected(self):
         from bigdl_tpu.nn.attention import MultiHeadAttention
