@@ -95,6 +95,12 @@ def train(argv):
                         help="LMHead + FusedLMHeadCriterion tail: the "
                         "(B,S,V) logits never materialise (plain data-"
                         "parallel path only)")
+    parser.add_argument("--llamaBlock", action="store_true",
+                        help="Llama-family block recipe: RoPE + RMSNorm + "
+                        "SwiGLU (untied log-prob tail, so every training "
+                        "mode drives it). Composes with --contextParallel "
+                        "(round 5: per-shard global rope positions — the "
+                        "long-context training recipe)")
     parser.add_argument("--textFile", default=None,
                         help="train on REAL text: BPE-tokenize this file "
                         "(--bpeVocab merges), save the tokenizer next to "
@@ -107,6 +113,12 @@ def train(argv):
     if args.contextParallel and args.tensorParallel > 1:
         raise SystemExit("--contextParallel and --tensorParallel are "
                          "separate modes; pick one")
+    if args.llamaBlock and args.moeExperts:
+        raise SystemExit("--llamaBlock (swiglu FFN) does not compose with "
+                         "--moeExperts yet")
+    if args.fusedHead and (args.contextParallel or args.tensorParallel > 1):
+        raise SystemExit("--fusedHead composes with the plain data-"
+                         "parallel path only")
     if args.textFile:
         samples, args.vocab = _text_corpus(args)
     else:
@@ -116,6 +128,9 @@ def train(argv):
                        distributed=args.tensorParallel > 1).transform(
         SampleToBatch(batch_size=args.batchSize))
 
+    llama_kwargs = (dict(rope=True, norm="rms", activation="swiglu",
+                         bias=False)
+                    if args.llamaBlock else {})
     model = transformer.build_lm(
         args.vocab, args.embedDim, args.numHeads, ffn_dim=4 * args.embedDim,
         num_layers=args.numLayers, max_len=max(1024, args.seqLen),
@@ -124,12 +139,8 @@ def train(argv):
         seq_layout=args.ringLayout if args.contextParallel == "ring"
         else "contiguous",
         moe_experts=args.moeExperts,
-        fused_head=args.fusedHead)
+        fused_head=args.fusedHead, **llama_kwargs)
     if args.fusedHead:
-        if args.contextParallel or args.tensorParallel > 1:
-            raise SystemExit("--fusedHead composes with the plain data-"
-                             "parallel path only (the CP/TP planes shard "
-                             "the standard tail)")
         criterion = nn.FusedLMHeadCriterion()
     else:
         criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
@@ -208,10 +219,17 @@ def _train_context_parallel(model, criterion, ds, args):
     method = SGD(learningrate=args.learningRate,
                  learningrate_decay=args.learningRateDecay,
                  momentum=args.momentum, weightdecay=args.weightDecay)
-    # model = [LookupTable, PositionalEncoding, TransformerEncoder,
-    #          TimeDistributed(Linear), LogSoftMax] (models/transformer.py)
-    embed = nn.Sequential().add(model[0]).add(model[1])
-    tail = nn.Sequential().add(model[2]).add(model[3]).add(model[4])
+    # model = [LookupTable, (PositionalEncoding — absent under rope),
+    #          TransformerEncoder, TimeDistributed(Linear), LogSoftMax]
+    # (models/transformer.py); split at the encoder so both layouts work
+    mods = list(model)
+    enc_idx = next(i for i, m in enumerate(mods)
+                   if isinstance(m, nn.TransformerEncoder))
+    embed, tail = nn.Sequential(), nn.Sequential()
+    for m in mods[:enc_idx]:
+        embed.add(m)
+    for m in mods[enc_idx:]:
+        tail.add(m)
     params = {"embed": embed.parameter_tree(), "tail": tail.parameter_tree()}
     opt_state = method.init_state(params)
 

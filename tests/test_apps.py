@@ -428,3 +428,21 @@ class TestFromHFLlamaSentencePiece:
         out = capsys.readouterr().out
         assert "prompt:       'the quick brown fox'" in out
         assert "continuation:" in out
+
+
+class TestLlamaBlockContextParallel:
+    """--llamaBlock --contextParallel: the long-context rope training
+    recipe is CLI-reachable end to end (round 5)."""
+
+    def test_train_ring_rope(self, capsys):
+        from bigdl_tpu.apps import transformer
+        transformer.train(["-b", "8", "--seqLen", "32", "--maxEpoch", "1",
+                           "--llamaBlock", "--contextParallel", "ring",
+                           "--ringLayout", "zigzag", "--numLayers", "1",
+                           "--embedDim", "16", "--numHeads", "2",
+                           "--synthetic-size", "16"])
+
+    def test_llamablock_moe_refused(self):
+        from bigdl_tpu.apps import transformer
+        with pytest.raises(SystemExit, match="moeExperts"):
+            transformer.train(["--llamaBlock", "--moeExperts", "4"])
