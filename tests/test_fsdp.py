@@ -190,3 +190,27 @@ class TestFsdpCollectives:
         # test_comm_contract.py.
         assert ("reduce-scatter" in hlo
                 or ("all-reduce" in hlo and "dynamic-slice" in hlo))
+
+
+class TestFsdpCompressedGradients:
+    def test_bf16_payload_parity(self):
+        """compress_gradients (the reference FP16CompressedTensor codec,
+        bf16 here) must compose with fsdp: both planes see the same
+        truncated gradients, so they stay numerically interchangeable."""
+        batches = _fixed_batches()
+        init = _fresh_init()
+
+        def train(sync_mode):
+            model = _mk_model()
+            model.load_parameter_tree(init)
+            opt = DistriOptimizer(model, _FixedDataSet(batches),
+                                  nn.ClassNLLCriterion(),
+                                  topology=MeshTopology.data_parallel(),
+                                  sync_mode=sync_mode,
+                                  compress_gradients=True)
+            opt.set_optim_method(SGD(learningrate=0.1, momentum=0.9))
+            opt.set_end_when(Trigger.max_epoch(2))
+            return _flat(opt.optimize().parameter_tree())
+
+        np.testing.assert_allclose(train("fsdp"), train("allreduce"),
+                                   rtol=1e-5, atol=1e-6)

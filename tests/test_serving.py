@@ -219,3 +219,24 @@ class TestContinuousQuantizedCompose:
                 assert got == want, ids
         finally:
             srv.close()
+
+
+class TestContinuousSampling:
+    def test_sampled_mode_terminates_and_varies(self):
+        """Temperature sampling through the slot engine: requests finish,
+        respect budgets, and two identical prompts admitted at different
+        times draw DIFFERENT samples (the per-admission PRNG key fix)."""
+        model = _mk_model(3)
+        srv = ContinuousLMServer(model, slots=2, max_len=32,
+                                 temperature=1.2, top_k=8, decode_block=4,
+                                 seed=5)
+        try:
+            outs = [srv.submit([4, 9, 2], 12, timeout=120)
+                    for _ in range(4)]
+            assert all(len(o) == 12 for o in outs)
+            assert all(1 <= t <= VOCAB for o in outs for t in o)
+            # 4 independent draws of 12 tokens from a warm temperature:
+            # all-identical would mean the keys collapsed
+            assert len({tuple(o) for o in outs}) > 1
+        finally:
+            srv.close()
