@@ -64,13 +64,13 @@ def _flat(params):
                            for l in jax.tree_util.tree_leaves(params)])
 
 
-def _train(batches, init, mk_method, sync_mode, epochs=2):
+def _train(batches, init, mk_method, sync_mode, epochs=2, **opt_kwargs):
     model = _mk_model()
     model.load_parameter_tree(init)
     opt = DistriOptimizer(model, _FixedDataSet(batches),
                           nn.ClassNLLCriterion(),
                           topology=MeshTopology.data_parallel(),
-                          sync_mode=sync_mode)
+                          sync_mode=sync_mode, **opt_kwargs)
     opt.set_optim_method(mk_method())
     opt.set_end_when(Trigger.max_epoch(epochs))
     return _flat(opt.optimize().parameter_tree())
@@ -199,18 +199,7 @@ class TestFsdpCompressedGradients:
         truncated gradients, so they stay numerically interchangeable."""
         batches = _fixed_batches()
         init = _fresh_init()
-
-        def train(sync_mode):
-            model = _mk_model()
-            model.load_parameter_tree(init)
-            opt = DistriOptimizer(model, _FixedDataSet(batches),
-                                  nn.ClassNLLCriterion(),
-                                  topology=MeshTopology.data_parallel(),
-                                  sync_mode=sync_mode,
-                                  compress_gradients=True)
-            opt.set_optim_method(SGD(learningrate=0.1, momentum=0.9))
-            opt.set_end_when(Trigger.max_epoch(2))
-            return _flat(opt.optimize().parameter_tree())
-
-        np.testing.assert_allclose(train("fsdp"), train("allreduce"),
-                                   rtol=1e-5, atol=1e-6)
+        mk = lambda: SGD(learningrate=0.1, momentum=0.9)
+        f = _train(batches, init, mk, "fsdp", compress_gradients=True)
+        a = _train(batches, init, mk, "allreduce", compress_gradients=True)
+        np.testing.assert_allclose(f, a, rtol=1e-5, atol=1e-6)
