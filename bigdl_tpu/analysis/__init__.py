@@ -1,0 +1,34 @@
+"""bigdl_tpu.analysis — graftlint, an AST-based JAX-hazard linter.
+
+Static analysis purpose-built for this codebase's JAX idioms: it walks
+every module's AST (never importing it), works out which functions are
+jit/pmap/scan-compiled, and flags the TPU hazards that are invisible
+until a run is slow or wrong — host syncs on traced values, trace-time
+side effects, PRNG key reuse, per-iteration recompilation, dead static
+declarations, tracer branching, donated-buffer reuse, and mutable
+default arguments.
+
+CLI::
+
+    python -m bigdl_tpu.analysis bigdl_tpu/            # lint the tree
+    python -m bigdl_tpu.analysis --list-rules          # rule table
+    python -m bigdl_tpu.analysis --select JG001,JG003 --format json paths...
+
+Suppression (the reason is mandatory)::
+
+    x = float(loss)  # graftlint: ignore[JG001] -- eager-only debug path
+
+The self-lint gate (``tests/test_graftlint.py``) keeps ``bigdl_tpu/``
+at zero unsuppressed findings; see ``docs/ANALYSIS.md``.
+"""
+
+from bigdl_tpu.analysis.core import (Finding, FileResult, Rule, RULES,
+                                     all_rules, lint_file, lint_paths,
+                                     lint_source, register, render_json,
+                                     render_text, select_rules)
+
+__all__ = [
+    "Finding", "FileResult", "Rule", "RULES", "all_rules", "lint_file",
+    "lint_paths", "lint_source", "register", "render_json", "render_text",
+    "select_rules",
+]

@@ -1,0 +1,84 @@
+"""graftlint CLI: ``python -m bigdl_tpu.analysis [paths...]``.
+
+Exit status 0 when every finding is suppressed (with a reason), 1 when
+unsuppressed findings remain, 2 on usage errors — so the command slots
+straight into CI and ``scripts/bigdl-tpu.sh lint``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from bigdl_tpu.analysis.core import (all_rules, lint_paths, render_json,
+                                     render_text)
+
+
+def _csv(value: str) -> List[str]:
+    return [v for v in value.split(",") if v.strip()]
+
+
+def default_paths() -> List[str]:
+    """The self-lint gate tree, resolved from the package location (not
+    the CWD): bigdl_tpu/ plus the repo's scripts/ when present."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = [pkg]
+    scripts = os.path.join(os.path.dirname(pkg), "scripts")
+    if os.path.isdir(scripts):
+        out.append(scripts)
+    return out
+
+
+def rule_table() -> str:
+    lines = ["code   summary", "-----  " + "-" * 66]
+    for rule in all_rules():
+        lines.append(f"{rule.code}  {rule.summary}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.analysis",
+        description="graftlint: AST-based JAX-hazard linter for bigdl_tpu")
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="files or directories to lint (default: the "
+                             "installed bigdl_tpu/ tree + sibling scripts/)")
+    parser.add_argument("--select", type=_csv, default=None, metavar="CODES",
+                        help="comma-separated rule codes to run (only)")
+    parser.add_argument("--ignore", type=_csv, default=None, metavar="CODES",
+                        help="comma-separated rule codes to skip")
+    parser.add_argument("--format", choices=("text", "json"), default="text",
+                        help="report format (default: text)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(rule_table())
+        return 0
+    paths = args.paths or default_paths()
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd path must not silently lint zero files and pass
+        print(f"graftlint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    try:
+        # lint_paths validates --select/--ignore codes via select_rules
+        results = lint_paths(paths, select=args.select, ignore=args.ignore)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    out = (render_json(results) if args.format == "json"
+           else render_text(results))
+    print(out)
+    return 1 if any(res.findings for res in results) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

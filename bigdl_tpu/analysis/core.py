@@ -1,0 +1,962 @@
+"""graftlint core: rule registry, compiled-context index, taint engine.
+
+BigDL's JVM lineage leaned on the Scala compiler to reject whole classes
+of wiring mistakes before they ran; the JAX port has no equivalent, and
+the hazards that matter on TPU — silent host syncs, trace-time side
+effects, PRNG key reuse, recompilation churn — surface only as slow or
+wrong runs. graftlint is a purpose-built AST linter for this codebase's
+JAX idioms: it never imports the modules it analyzes (pure ``ast`` +
+``tokenize``), so linting all of ``bigdl_tpu/`` takes well under a
+second and is safe to run as a tier-1 gate.
+
+Three layers live here:
+
+- **JitIndex** — which functions run under a JAX trace. *Seeds* are
+  trace entry points whose parameters are tracers: decorator forms
+  (``@jax.jit``, ``@partial(jax.jit, ...)``, ``@jax.custom_vjp`` ...),
+  call-site wrapping (``fn = jax.jit(run)``) resolved with lexical
+  visibility, and function arguments to ``lax.scan`` / ``while_loop`` /
+  ``cond`` / ``vmap`` / ``grad`` / ``shard_map``. The *compiled* set is
+  the closure of seeds under nesting and same-module ``Name``-call
+  propagation (a helper called from a traced function runs under the
+  trace too, but its parameters are NOT assumed to be tracers — builder
+  helpers take Python config constantly).
+- **Taint engine** (``iter_trace_events``) — inside each compiled
+  function, an order-sensitive walk tracking which names hold traced
+  values. Seed parameters are tainted (minus ``static_argnums`` /
+  ``static_argnames`` / ``nondiff_argnums``); ``jnp.*``/``jax.*`` call
+  results are tainted; ``.shape``/``.ndim``/``.dtype``/``len()`` and
+  host conversions yield static values. Rules consume the emitted
+  events (host-sync calls, tracer branches).
+- **Suppressions** — ``# graftlint: ignore[JG001] -- reason``. The
+  reason is mandatory: a bare ignore does not suppress and is itself
+  reported (JG000, unsuppressable).
+
+See ``docs/ANALYSIS.md`` for the rule catalogue and how to add a rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import (Dict, Iterable, Iterator, List, Optional, Sequence, Set,
+                    Tuple)
+
+# --------------------------------------------------------------------------
+# Findings and rules
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``path:line:col: CODE message``. ``end_line`` is
+    the last physical line of the flagged construct — a suppression
+    anywhere in [line, end_line] applies (flake8-noqa style trailing
+    comments on multi-line statements)."""
+
+    code: str
+    message: str
+    path: str
+    line: int
+    col: int = 0
+    end_line: int = 0
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+class Rule:
+    """Base class for graftlint rules.
+
+    Subclasses set ``code`` (``JG0xx``) and ``summary`` (one line, used
+    in reports and the generated rule table) and implement
+    ``check(ctx)`` yielding :class:`Finding`. The class docstring is the
+    rule's rationale — it feeds the rule table in ``docs/API.md`` via
+    ``scripts/gen_api_doc.py``, so write it for users.
+    """
+
+    code: str = ""
+    summary: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "FileContext", node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(self.code, message, ctx.path, line,
+                       getattr(node, "col_offset", 0),
+                       getattr(node, "end_lineno", line) or line)
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule instance to the global registry."""
+    if not cls.code or not cls.code.startswith("JG"):
+        raise ValueError(f"rule {cls.__name__} needs a JGxxx code")
+    if cls.code in RULES:
+        raise ValueError(f"duplicate rule code {cls.code}")
+    RULES[cls.code] = cls()
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    """Registered rules, sorted by code (imports the rule package)."""
+    import bigdl_tpu.analysis.rules  # noqa: F401  (registration side effect)
+    return [RULES[c] for c in sorted(RULES)]
+
+
+# --------------------------------------------------------------------------
+# Suppressions
+# --------------------------------------------------------------------------
+
+SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*ignore\[([A-Za-z0-9,\s]+)\]\s*(?:--\s*(\S.*))?")
+
+
+@dataclass
+class Suppression:
+    line: int
+    codes: Tuple[str, ...]
+    reason: Optional[str]
+    used: bool = False  # matched at least one finding this run
+
+
+def _scan_suppressions(source: str) -> Tuple[Dict[int, List[Suppression]],
+                                             Set[int]]:
+    """Map line -> suppressions, plus the set of comment-only lines."""
+    by_line: Dict[int, List[Suppression]] = {}
+    comment_only: Set[int] = set()
+    comment_lines: Set[int] = set()
+    line_has_code: Dict[int, bool] = {}
+    try:
+        toks = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return by_line, comment_only
+    for tok in toks:
+        line = tok.start[0]
+        if tok.type == tokenize.COMMENT:
+            comment_lines.add(line)
+            m = SUPPRESS_RE.search(tok.string)
+            if m:
+                codes = tuple(c.strip().upper() for c in m.group(1).split(",")
+                              if c.strip())
+                reason = m.group(2)
+                by_line.setdefault(line, []).append(Suppression(
+                    line, codes, reason.strip() if reason else None))
+        elif tok.type not in (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+                              tokenize.DEDENT, tokenize.ENCODING,
+                              tokenize.ENDMARKER):
+            for ln in range(tok.start[0], tok.end[0] + 1):
+                line_has_code[ln] = True
+    # EVERY comment-only line (suppression or not) is climbable, so an
+    # ignore can sit above further explanatory comment lines
+    for line in comment_lines:
+        if not line_has_code.get(line):
+            comment_only.add(line)
+    return by_line, comment_only
+
+
+# --------------------------------------------------------------------------
+# Compiled-context index
+# --------------------------------------------------------------------------
+
+# dotted callables that jit-compile the function they wrap
+_JIT_WRAPPERS = {
+    "jax.jit", "jit", "jax.pmap", "pmap", "pjit",
+    "jax.experimental.pjit.pjit",
+}
+# dotted callables that trace the function they wrap
+_TRACE_WRAPPERS = _JIT_WRAPPERS | {
+    "jax.vmap", "vmap", "jax.grad", "jax.value_and_grad", "jax.jacfwd",
+    "jax.jacrev", "jax.hessian", "jax.linearize", "jax.vjp", "jax.jvp",
+    "jax.custom_vjp", "jax.custom_jvp", "jax.checkpoint", "jax.remat",
+    "checkpoint", "remat", "shard_map", "jax.experimental.shard_map.shard_map",
+}
+# callables whose *function-valued arguments* run under trace
+_TRACE_HIGHER_ORDER = _TRACE_WRAPPERS | {
+    "jax.lax.scan", "lax.scan", "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.cond", "lax.cond", "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.associative_scan", "lax.associative_scan",
+    "jax.lax.map", "lax.map", "jax.lax.switch", "lax.switch",
+}
+# keyword names those combinators use for their function arguments
+_FUNC_KWARGS = {"f", "fun", "body_fun", "cond_fun", "body", "true_fun",
+                "false_fun"}
+# jit-wrapper kwargs naming non-traced (static) parameters
+_STATIC_KWARGS = ("static_argnums", "static_argnames", "nondiff_argnums")
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.lax.scan`` for Name-rooted Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _unwrap_partial(call: ast.Call) -> Optional[str]:
+    """``functools.partial(jax.jit, ...)`` -> ``"jax.jit"``, else None."""
+    fn = dotted_name(call.func)
+    if fn in ("functools.partial", "partial") and call.args:
+        return dotted_name(call.args[0])
+    return None
+
+
+_FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+FuncNode = ast.FunctionDef
+
+# shared mutable-default detection (JG005 static defaults + JG008): a
+# default built by a ctor call is created once and shared regardless of
+# whether the call takes arguments — dict(momentum=0.9) is as shared as {}
+MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                    ast.SetComp)
+MUTABLE_CTORS = {"list", "dict", "set", "bytearray", "defaultdict",
+                 "OrderedDict", "collections.defaultdict",
+                 "collections.OrderedDict", "collections.deque", "deque"}
+
+
+def is_mutable_default(node: ast.AST) -> bool:
+    """True when a parameter default expression is a shared mutable
+    object (literal or ctor call)."""
+    if isinstance(node, MUTABLE_LITERALS):
+        return True
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func) in MUTABLE_CTORS
+    return False
+
+
+def _positional_params(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in list(getattr(a, "posonlyargs", [])) + list(a.args)]
+
+
+def _all_params(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in list(getattr(a, "posonlyargs", []))
+             + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _static_names_from_call(call: ast.Call, fn: ast.AST) -> Set[str]:
+    """Resolve static_argnums/static_argnames/nondiff_argnums keywords of
+    a jit-like wrapper call to parameter NAMES of ``fn``."""
+    out: Set[str] = set()
+    pos = _positional_params(fn)
+    for kw in call.keywords:
+        if kw.arg not in _STATIC_KWARGS:
+            continue
+        values: List[ast.expr]
+        if isinstance(kw.value, (ast.Tuple, ast.List)):
+            values = list(kw.value.elts)
+        else:
+            values = [kw.value]
+        for v in values:
+            if not isinstance(v, ast.Constant):
+                continue
+            if isinstance(v.value, int) and not isinstance(v.value, bool):
+                if 0 <= v.value < len(pos):
+                    out.add(pos[v.value])
+            elif isinstance(v.value, str):
+                out.add(v.value)
+    return out
+
+
+class JitIndex:
+    """Which function defs in a module run under a JAX trace.
+
+    ``seeds`` are trace entry points (parameters are tracers);
+    ``compiled`` additionally contains every function reachable from a
+    seed by lexical nesting or same-module ``Name`` calls (runs at trace
+    time, parameters not assumed traced). ``static_params`` maps seed
+    nodes to parameter names declared static on the wrapper.
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.seeds: Set[ast.AST] = set()
+        self.compiled: Set[ast.AST] = set()
+        self.static_params: Dict[ast.AST, Set[str]] = {}
+        self.parent: Dict[ast.AST, Optional[ast.AST]] = {}
+        self.functions: List[FuncNode] = []
+        self._by_name: Dict[str, List[FuncNode]] = {}
+        self._index(tree)
+        self._seed(tree)
+        self._propagate()
+
+    # -- construction ------------------------------------------------------
+    def _index(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        for node in ast.walk(tree):
+            if isinstance(node, _FUNC_TYPES):
+                self.functions.append(node)
+                self._by_name.setdefault(node.name, []).append(node)
+
+    def _seed(self, tree: ast.Module) -> None:
+        # decorator forms
+        for fn in self.functions:
+            for dec in fn.decorator_list:
+                name = dotted_name(dec)
+                if name in _TRACE_WRAPPERS:
+                    self._add_seed(fn)
+                elif isinstance(dec, ast.Call):
+                    inner = dotted_name(dec.func)
+                    if inner in _TRACE_WRAPPERS:
+                        self._add_seed(fn, _static_names_from_call(dec, fn))
+                    elif _unwrap_partial(dec) in _TRACE_WRAPPERS:
+                        self._add_seed(fn, _static_names_from_call(dec, fn))
+        # call-site wrapping + higher-order function arguments
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee is None and isinstance(node.func, ast.Call):
+                callee = _unwrap_partial(node.func)
+            if callee not in _TRACE_HIGHER_ORDER:
+                continue
+            for arg in node.args:
+                self._seed_func_arg(arg, node)
+            for kw in node.keywords:
+                if kw.arg in _FUNC_KWARGS:
+                    self._seed_func_arg(kw.value, node)
+
+    def _add_seed(self, fn: ast.AST, statics: Optional[Set[str]] = None):
+        self.seeds.add(fn)
+        self.compiled.add(fn)
+        if statics:
+            self.static_params.setdefault(fn, set()).update(statics)
+
+    def _seed_func_arg(self, arg: ast.AST, call: ast.Call) -> None:
+        if isinstance(arg, ast.Lambda):
+            self._add_seed(arg)
+        elif isinstance(arg, ast.Name):
+            for fn in self._resolve_name(arg.id, call):
+                self._add_seed(fn, _static_names_from_call(call, fn))
+
+    def _resolve_name(self, name: str, at: ast.AST) -> List[FuncNode]:
+        """Defs named ``name`` lexically visible from ``at`` — innermost
+        scope wins (several defs can share the innermost scope, e.g. one
+        per branch of an ``if``)."""
+        candidates = self._by_name.get(name, [])
+        if not candidates:
+            return []
+        ancestors = []
+        node: Optional[ast.AST] = at
+        while node is not None:
+            ancestors.append(node)
+            node = self.parent.get(node)
+        anc_set = {id(a) for a in ancestors}
+        scored: List[Tuple[int, FuncNode]] = []
+        for fn in candidates:
+            scope = self._enclosing_scope(fn)
+            if scope is None or id(scope) in anc_set:
+                depth = self._depth(fn)
+                scored.append((depth, fn))
+        if not scored:
+            return list(candidates)  # conservative: mark them all
+        best = max(d for d, _ in scored)
+        return [fn for d, fn in scored if d == best]
+
+    def _enclosing_scope(self, fn: ast.AST) -> Optional[ast.AST]:
+        node = self.parent.get(fn)
+        while node is not None and not isinstance(node, _FUNC_TYPES):
+            node = self.parent.get(node)
+        return node
+
+    def _depth(self, fn: ast.AST) -> int:
+        d = 0
+        node = self.parent.get(fn)
+        while node is not None:
+            d += 1
+            node = self.parent.get(node)
+        return d
+
+    def _propagate(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(self.compiled):
+                for node in ast.walk(fn):
+                    if (isinstance(node, _FUNC_TYPES)
+                            and node not in self.compiled):
+                        self.compiled.add(node)
+                        changed = True
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Name)):
+                        for callee in self._resolve_name(node.func.id, node):
+                            if callee not in self.compiled:
+                                self.compiled.add(callee)
+                                changed = True
+
+    # -- queries -----------------------------------------------------------
+    def is_compiled(self, fn: ast.AST) -> bool:
+        return fn in self.compiled
+
+    def compiled_ancestor(self, fn: ast.AST) -> Optional[ast.AST]:
+        node = self.parent.get(fn)
+        while node is not None:
+            if node in self.compiled:
+                return node
+            node = self.parent.get(node)
+        return None
+
+    def seed_ancestor_or_self(self, fn: ast.AST) -> bool:
+        node: Optional[ast.AST] = fn
+        while node is not None:
+            if node in self.seeds:
+                return True
+            node = self.parent.get(node)
+        return False
+
+    def taint_roots(self) -> List[ast.AST]:
+        """Compiled functions AND jitted lambdas with no compiled
+        ancestor — the taint engine descends into nested defs itself.
+        (Lambdas live only in ``compiled``/``seeds``, not ``functions``:
+        ``fn = jax.jit(lambda x: ...)`` sites must still be walked.)"""
+        roots = [fn for fn in self.functions
+                 if fn in self.compiled
+                 and self.compiled_ancestor(fn) is None]
+        roots += [n for n in self.compiled
+                  if isinstance(n, ast.Lambda)
+                  and self.compiled_ancestor(n) is None]
+        return sorted(roots, key=lambda n: (n.lineno, n.col_offset))
+
+    def qualname(self, fn: ast.AST) -> str:
+        parts = [getattr(fn, "name", "<lambda>")]
+        node = self.parent.get(fn)
+        while node is not None:
+            if isinstance(node, (*_FUNC_TYPES, ast.ClassDef)):
+                parts.append(node.name)
+            node = self.parent.get(node)
+        return ".".join(reversed(parts))
+
+
+def iter_own_statements(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's nodes WITHOUT entering nested def/lambda bodies
+    (nested functions are analyzed on their own)."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (*_FUNC_TYPES, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+# --------------------------------------------------------------------------
+# Trace-taint engine
+# --------------------------------------------------------------------------
+
+# results of these attribute reads are static Python metadata, never tracers
+_STATIC_ATTRS = {"shape", "ndim", "dtype"}
+# builtins whose results are static regardless of argument taint
+_STATIC_BUILTINS = {"len", "isinstance", "type", "id", "hasattr", "range",
+                    "str", "repr", "callable", "issubclass", "format"}
+# host-converting calls: consume a traced value by forcing it to the host
+_HOST_CONVERTERS = {"float", "int", "bool", "complex",
+                    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                    "np.float32", "np.float64", "np.int32", "np.int64",
+                    "np.uint8", "np.bool_", "onp.asarray", "onp.array"}
+_HOST_METHODS = {"item", "tolist", "block_until_ready"}
+# namespaces whose call results are device values under trace
+_ARRAY_NAMESPACES = ("jnp.", "jax.", "lax.")
+# ...except these, which return static Python values even under trace
+_STATIC_JAX_CALLS = {
+    "jax.lax.axis_size", "lax.axis_size", "jax.device_count",
+    "jax.local_device_count", "jax.process_count", "jax.process_index",
+    "jax.default_backend", "jax.devices", "jax.local_devices",
+    "jax.eval_shape", "jax.ShapeDtypeStruct",
+    "jax.tree_util.tree_structure", "jnp.ndim", "jnp.shape",
+}
+
+
+@dataclass
+class TraceEvent:
+    """One hazard candidate inside a compiled function."""
+
+    kind: str          # "host_sync" | "tracer_branch"
+    node: ast.AST      # anchor for line/col
+    detail: str        # converter name / branch test source
+    qualname: str      # compiled function it occurred in
+
+
+class _TaintWalker:
+    """Order-sensitive walk of one compiled function.
+
+    Tracks the set of names bound to traced values. Seed parameters are
+    traced (minus declared-static names); closure variables inherit the
+    enclosing walk's taint; ``jnp.*``/``jax.*`` results are traced;
+    ``.shape``/``.ndim``/``.dtype``/``len()`` and host conversions yield
+    static values. Branch arms are analyzed independently and
+    union-merged; loop bodies run twice so second-iteration taint is
+    seen.
+    """
+
+    def __init__(self, index: JitIndex, events: List[TraceEvent],
+                 src: Optional[str] = None):
+        self.index = index
+        self.events = events
+        self.src = src
+
+    # -- entry -------------------------------------------------------------
+    def run(self, fn: ast.AST, inherited: Optional[Set[str]] = None) -> None:
+        tainted: Set[str] = set(inherited or ())
+        if self.index.seed_ancestor_or_self(fn):
+            statics = self.index.static_params.get(fn, set())
+            for name in _all_params(fn):
+                if name not in statics:
+                    tainted.add(name)
+                else:
+                    tainted.discard(name)
+        else:
+            # propagated helper: parameters unknown — assume static so
+            # builder-style Python config doesn't false-positive; traced
+            # values still appear via jnp./jax. results
+            for name in _all_params(fn):
+                tainted.discard(name)
+        self._fn = fn
+        if isinstance(fn, ast.Lambda):
+            self._expr(fn.body, tainted)
+        else:
+            self._block(fn.body, tainted)
+
+    # -- statements --------------------------------------------------------
+    def _block(self, stmts: Sequence[ast.stmt], tainted: Set[str]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, tainted)
+
+    def _nested(self, fn: ast.AST, tainted: Set[str]) -> None:
+        sub = _TaintWalker(self.index, self.events, self.src)
+        sub.run(fn, inherited=set(tainted))
+
+    def _stmt(self, stmt: ast.stmt, tainted: Set[str]) -> None:
+        if isinstance(stmt, _FUNC_TYPES):
+            for dec in stmt.decorator_list:
+                self._expr(dec, tainted)
+            self._nested(stmt, tainted)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            is_tainted = self._expr(value, tainted) if value else False
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for tgt in targets:
+                if isinstance(stmt, ast.AugAssign):
+                    is_tainted = is_tainted or self._expr(tgt, tainted)
+                self._bind(tgt, is_tainted, tainted)
+            return
+        if isinstance(stmt, ast.If):
+            self._branch_test(stmt.test, tainted)
+            t1, t2 = set(tainted), set(tainted)
+            self._block(stmt.body, t1)
+            self._block(stmt.orelse, t2)
+            tainted |= t1 | t2
+            return
+        if isinstance(stmt, ast.While):
+            self._branch_test(stmt.test, tainted)
+            for _ in range(2):
+                t1 = set(tainted)
+                self._block(stmt.body, t1)
+                tainted |= t1
+            self._block(stmt.orelse, tainted)
+            return
+        if isinstance(stmt, ast.For):
+            it_tainted = self._expr(stmt.iter, tainted)
+            for _ in range(2):
+                self._bind(stmt.target, it_tainted, tainted)
+                t1 = set(tainted)
+                self._block(stmt.body, t1)
+                tainted |= t1
+            self._block(stmt.orelse, tainted)
+            return
+        if isinstance(stmt, ast.Try):
+            t1 = set(tainted)
+            self._block(stmt.body, t1)
+            tainted |= t1
+            for handler in stmt.handlers:
+                th = set(tainted)
+                self._block(handler.body, th)
+                tainted |= th
+            self._block(stmt.orelse, tainted)
+            self._block(stmt.finalbody, tainted)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._expr(item.context_expr, tainted)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, False, tainted)
+            self._block(stmt.body, tainted)
+            return
+        if isinstance(stmt, ast.Assert):
+            self._branch_test(stmt.test, tainted)
+            return
+        if isinstance(stmt, (ast.Return, ast.Expr)) and \
+                getattr(stmt, "value", None) is not None:
+            self._expr(stmt.value, tainted)
+            return
+        # default (Raise, Delete, Import, ...): visit child expressions
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self._expr(node, tainted)
+
+    def _bind(self, target: ast.expr, is_tainted: bool,
+              tainted: Set[str]) -> None:
+        if isinstance(target, ast.Name):
+            if is_tainted:
+                tainted.add(target.id)
+            else:
+                tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, is_tainted, tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, is_tainted, tainted)
+        # Attribute/Subscript stores don't (re)bind local names
+
+    def _branch_test(self, test: ast.expr, tainted: Set[str]) -> None:
+        if self._expr(test, tainted):
+            self.events.append(TraceEvent(
+                "tracer_branch", test, self._src_of(test),
+                self.index.qualname(self._fn)))
+
+    def _src_of(self, node: ast.AST) -> str:
+        if self.src is not None:
+            try:
+                seg = ast.get_source_segment(self.src, node)
+                if seg:
+                    return " ".join(seg.split())[:60]
+            except Exception:  # pragma: no cover - malformed positions
+                pass
+        return type(node).__name__
+
+    # -- expressions: return taint, emit events ----------------------------
+    def _expr_list(self, exprs: Iterable[Optional[ast.expr]],
+                   tainted: Set[str]) -> bool:
+        hit = False
+        for e in exprs:
+            if e is not None:
+                hit = self._expr(e, tainted) or hit
+        return hit
+
+    def _expr(self, node: ast.expr, tainted: Set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            base = self._expr(node.value, tainted)
+            return False if node.attr in _STATIC_ATTRS else base
+        if isinstance(node, ast.Subscript):
+            base = self._expr(node.value, tainted)
+            self._expr(node.slice, tainted)
+            return base
+        if isinstance(node, ast.Call):
+            return self._call(node, tainted)
+        if isinstance(node, ast.BinOp):
+            l = self._expr(node.left, tainted)
+            return self._expr(node.right, tainted) or l
+        if isinstance(node, ast.UnaryOp):
+            return self._expr(node.operand, tainted)
+        if isinstance(node, ast.BoolOp):
+            return self._expr_list(node.values, tainted)
+        if isinstance(node, ast.Compare):
+            hit = self._expr(node.left, tainted)
+            return self._expr_list(node.comparators, tainted) or hit
+        if isinstance(node, ast.IfExp):
+            self._branch_test(node.test, tainted)
+            body = self._expr(node.body, tainted)
+            return self._expr(node.orelse, tainted) or body
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return self._expr_list(node.elts, tainted)
+        if isinstance(node, ast.Dict):
+            hit = self._expr_list(node.keys, tainted)
+            return self._expr_list(node.values, tainted) or hit
+        if isinstance(node, ast.Starred):
+            return self._expr(node.value, tainted)
+        if isinstance(node, ast.Slice):
+            return self._expr_list((node.lower, node.upper, node.step),
+                                   tainted)
+        if isinstance(node, ast.Lambda):
+            self._nested(node, tainted)
+            return True  # a lambda closing over tracers is opaque
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            ct = set(tainted)
+            hit = False
+            for gen in node.generators:
+                it = self._expr(gen.iter, ct)
+                self._bind(gen.target, it, ct)
+                hit = it or hit
+                for cond in gen.ifs:
+                    self._expr(cond, ct)
+            if isinstance(node, ast.DictComp):
+                hit = self._expr(node.key, ct) or hit
+                hit = self._expr(node.value, ct) or hit
+            else:
+                hit = self._expr(node.elt, ct) or hit
+            return hit
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self._expr(v.value, tainted)
+            return False
+        # conservative default: visit children, propagate any taint
+        hit = False
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                hit = self._expr(child, tainted) or hit
+        return hit
+
+    def _call(self, node: ast.Call, tainted: Set[str]) -> bool:
+        callee = dotted_name(node.func)
+        recv_taint = False
+        if isinstance(node.func, ast.Attribute):
+            if callee is None:
+                # computed receiver, e.g. ``(x + y).sum()`` — visit once
+                recv_taint = self._expr(node.func.value, tainted)
+            else:
+                root = node.func
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                recv_taint = (isinstance(root, ast.Name)
+                              and root.id in tainted)
+        arg_taint = self._expr_list(node.args, tainted)
+        kw_taint = self._expr_list((kw.value for kw in node.keywords),
+                                   tainted)
+        any_taint = arg_taint or kw_taint
+
+        if callee in _HOST_CONVERTERS and any_taint:
+            self.events.append(TraceEvent(
+                "host_sync", node, f"{callee}()",
+                self.index.qualname(self._fn)))
+            return False  # result lives on the host
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_METHODS and recv_taint):
+            self.events.append(TraceEvent(
+                "host_sync", node, f".{node.func.attr}()",
+                self.index.qualname(self._fn)))
+            return False
+        if callee in _STATIC_BUILTINS or callee in _STATIC_JAX_CALLS:
+            return False
+        if callee is not None and callee.startswith(_ARRAY_NAMESPACES):
+            return True  # device-array-producing namespace
+        return recv_taint or any_taint
+
+
+def iter_trace_events(ctx: "FileContext") -> List[TraceEvent]:
+    """All taint events for the file, computed once and cached on ctx."""
+    if ctx._trace_events is None:
+        events: List[TraceEvent] = []
+        walker = _TaintWalker(ctx.jit_index, events, ctx.source)
+        for fn in ctx.jit_index.taint_roots():
+            walker.run(fn)
+        ctx._trace_events = events
+    return ctx._trace_events
+
+
+# --------------------------------------------------------------------------
+# File context and driver
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class FileContext:
+    """Everything a rule needs about one source file."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    jit_index: JitIndex
+    suppressions: Dict[int, List[Suppression]]
+    comment_only_lines: Set[int]
+    _trace_events: Optional[List[TraceEvent]] = field(default=None,
+                                                      repr=False)
+
+    @classmethod
+    def parse(cls, path: str, source: Optional[str] = None) -> "FileContext":
+        if source is None:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        tree = ast.parse(source, filename=path)
+        by_line, comment_only = _scan_suppressions(source)
+        return cls(path=path, source=source, tree=tree,
+                   jit_index=JitIndex(tree), suppressions=by_line,
+                   comment_only_lines=comment_only)
+
+    def suppressions_for(self, line: int) -> List[Suppression]:
+        """Suppressions applying to a finding at ``line``: same line,
+        plus any stack of comment-only lines directly above."""
+        out = list(self.suppressions.get(line, ()))
+        ln = line - 1
+        while ln in self.comment_only_lines:
+            out.extend(self.suppressions.get(ln, ()))
+            ln -= 1
+        return out
+
+
+@dataclass
+class FileResult:
+    path: str
+    findings: List[Finding]            # unsuppressed (reportable)
+    suppressed: List[Finding]          # matched by a reasoned suppression
+
+
+def lint_source(path: str, source: str,
+                rules: Optional[Sequence[Rule]] = None) -> FileResult:
+    """Lint one in-memory source buffer (fixture tests use this)."""
+    rules = list(rules) if rules is not None else all_rules()
+    try:
+        ctx = FileContext.parse(path, source)
+    except SyntaxError as e:
+        return FileResult(path, [Finding(
+            "JG000", f"syntax error prevents analysis: {e.msg}", path,
+            e.lineno or 1, (e.offset or 1) - 1)], [])
+    raw: List[Finding] = []
+    for rule in rules:
+        raw.extend(rule.check(ctx))
+    reported: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in raw:
+        # candidates: the anchor line (plus its comment-only stack above)
+        # and every further physical line of the flagged construct, so a
+        # flake8-style trailing comment on a multi-line call applies
+        cands = ctx.suppressions_for(f.line)
+        for ln in range(f.line + 1, max(f.line, f.end_line) + 1):
+            cands.extend(ctx.suppressions.get(ln, ()))
+        matching = [s for s in cands if f.code in s.codes]
+        for s in matching:
+            s.used = True  # EVERY match is used — a duplicate reasoned
+            # ignore must not be misreported as stale below
+        matched = next((s for s in matching if s.reason),
+                       matching[0] if matching else None)
+        if matched is not None and matched.reason:
+            suppressed.append(f)
+        else:
+            # a reasonless suppression does not suppress (and is itself
+            # reported below)
+            reported.append(f)
+    active_codes = {r.code for r in rules}
+    for sups in ctx.suppressions.values():
+        for sup in sups:
+            if sup.reason is None:
+                reported.append(Finding(
+                    "JG000", "suppression requires a reason: write "
+                    "'# graftlint: ignore[JG0xx] -- why this is deliberate'",
+                    path, sup.line))
+            elif not sup.used and set(sup.codes) <= active_codes:
+                # (only judged when every named rule actually ran, so a
+                # --select subset doesn't misreport other codes as stale)
+                reported.append(Finding(
+                    "JG000", f"unused suppression "
+                    f"[{','.join(sup.codes)}]: no matching finding on "
+                    f"this line — remove it, or fix its placement",
+                    path, sup.line))
+    reported.sort(key=lambda f: (f.line, f.col, f.code))
+    suppressed.sort(key=lambda f: (f.line, f.col, f.code))
+    return FileResult(path, reported, suppressed)
+
+
+def lint_file(path: str,
+              rules: Optional[Sequence[Rule]] = None) -> FileResult:
+    """Lint one file on disk; returns its reported + suppressed findings."""
+    with open(path, encoding="utf-8") as f:
+        return lint_source(path, f.read(), rules)
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(".")
+                                 and d != "__pycache__")
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def select_rules(select: Optional[Iterable[str]] = None,
+                 ignore: Optional[Iterable[str]] = None) -> List[Rule]:
+    """Registered rules filtered by --select/--ignore code lists
+    (ValueError on an unknown code)."""
+    rules = all_rules()
+    for label, codes in (("select", select), ("ignore", ignore)):
+        if codes:
+            unknown = ({c.strip().upper() for c in codes if c.strip()}
+                       - set(RULES))
+            if unknown:
+                raise ValueError(
+                    f"--{label}: unknown rule code(s) {sorted(unknown)}")
+    if select:
+        want = {c.strip().upper() for c in select}
+        rules = [r for r in rules if r.code in want]
+    if ignore:
+        drop = {c.strip().upper() for c in ignore}
+        rules = [r for r in rules if r.code not in drop]
+    return rules
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Iterable[str]] = None,
+               ignore: Optional[Iterable[str]] = None) -> List[FileResult]:
+    """Lint every ``.py`` file under the given files/directories with the
+    selected rules; one FileResult per file, in walk order."""
+    rules = select_rules(select, ignore)
+    return [lint_file(p, rules) for p in iter_python_files(paths)]
+
+
+# --------------------------------------------------------------------------
+# Reporters
+# --------------------------------------------------------------------------
+
+
+def render_text(results: Sequence[FileResult]) -> str:
+    """One ``path:line:col: CODE message`` line per finding, plus a
+    summary tail (findings / suppressed / files)."""
+    lines: List[str] = []
+    n_find = n_sup = 0
+    for res in results:
+        for f in res.findings:
+            lines.append(f.render())
+            n_find += 1
+        n_sup += len(res.suppressed)
+    lines.append(f"graftlint: {n_find} finding(s), {n_sup} suppressed, "
+                 f"{len(results)} file(s)")
+    return "\n".join(lines)
+
+
+def render_json(results: Sequence[FileResult]) -> str:
+    """Machine-readable report: {findings, suppressed, files} (CI and
+    editor integrations consume this)."""
+    payload = {
+        "findings": [
+            {"code": f.code, "message": f.message, "path": f.path,
+             "line": f.line, "col": f.col}
+            for res in results for f in res.findings],
+        "suppressed": [
+            {"code": f.code, "path": f.path, "line": f.line}
+            for res in results for f in res.suppressed],
+        "files": len(results),
+    }
+    return json.dumps(payload, indent=2)

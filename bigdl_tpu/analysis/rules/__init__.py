@@ -1,0 +1,18 @@
+"""graftlint rule catalogue — importing this package registers every rule.
+
+Each module holds one rule class decorated with
+:func:`bigdl_tpu.analysis.core.register`. Add a new rule by dropping a
+module here that defines a ``Rule`` subclass with a unique ``JG0xx``
+code; see ``docs/ANALYSIS.md`` for the walkthrough.
+"""
+
+from bigdl_tpu.analysis.rules import (  # noqa: F401
+    donation,
+    host_sync,
+    jit_in_loop,
+    mutable_defaults,
+    prng,
+    side_effects,
+    static_args,
+    tracer_branch,
+)

@@ -1,0 +1,158 @@
+"""JG007 — reuse of a buffer after it was donated to a jitted call."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Sequence, Set, Tuple
+
+from bigdl_tpu.analysis.core import (FileContext, Finding, Rule, _FUNC_TYPES,
+                                     _JIT_WRAPPERS, dotted_name, register)
+
+
+def _donated_positions(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        out = []
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+@register
+class DonatedBufferReuseRule(Rule):
+    """``donate_argnums`` hands the argument's device buffer to XLA for
+    in-place reuse; after the call the donated array is DELETED — any
+    later read raises ``RuntimeError: Array has been deleted`` (or,
+    worse on some backends, reads garbage). The idiom is
+    ``params = step(params, ...)``: rebind the donated name from the
+    call's result and never touch the old reference again.
+    """
+
+    code = "JG007"
+    summary = ("a variable passed at a donate_argnums position is read "
+               "again after the call (donated buffers are deleted)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        self._ctx = ctx
+        self._findings: List[Finding] = []
+        for fn in ctx.jit_index.functions:
+            # donating wrappers bound to a local name in this function
+            donors: Dict[str, Tuple[int, ...]] = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    callee = dotted_name(node.value.func)
+                    if callee in _JIT_WRAPPERS:
+                        pos = _donated_positions(node.value)
+                        if pos:
+                            for tgt in node.targets:
+                                if isinstance(tgt, ast.Name):
+                                    donors[tgt.id] = pos
+            if donors:
+                self._walk(fn.body, donors, dead=set())
+        yield from self._findings
+
+    # ------------------------------------------------------------------
+    def _walk(self, stmts: Sequence[ast.stmt], donors: Dict[str, tuple],
+              dead: Set[str]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, donors, dead)
+
+    def _stmt(self, stmt: ast.stmt, donors: Dict[str, tuple],
+              dead: Set[str]) -> None:
+        if isinstance(stmt, (*_FUNC_TYPES, ast.ClassDef)):
+            return  # nested scopes analyzed via their own pass
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if stmt.value is not None:
+                self._expr(stmt.value, donors, dead)
+            if isinstance(stmt, ast.AugAssign) and \
+                    isinstance(stmt.target, ast.Name) and \
+                    stmt.target.id in dead:
+                # 'donated += x' READS the deleted buffer before rebinding
+                self._report(stmt.target, dead)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for tgt in targets:
+                self._revive(tgt, dead)
+            return
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, donors, dead)
+            d1, d2 = set(dead), set(dead)
+            self._walk(stmt.body, donors, d1)
+            self._walk(stmt.orelse, donors, d2)
+            dead.clear()
+            dead.update(d1 | d2)
+            return
+        if isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                self._expr(stmt.iter, donors, dead)
+                self._revive(stmt.target, dead)
+            else:
+                self._expr(stmt.test, donors, dead)
+            for _ in range(2):  # second pass: reuse across iterations
+                d1 = set(dead)
+                self._walk(stmt.body, donors, d1)
+                dead.update(d1)
+            self._walk(stmt.orelse, donors, dead)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk(stmt.body, donors, dead)
+            for handler in stmt.handlers:
+                self._walk(handler.body, donors, dead)
+            self._walk(stmt.orelse, donors, dead)
+            self._walk(stmt.finalbody, donors, dead)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._expr(item.context_expr, donors, dead)
+            self._walk(stmt.body, donors, dead)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, donors, dead)
+
+    def _revive(self, target: ast.expr, dead: Set[str]) -> None:
+        if isinstance(target, ast.Name):
+            dead.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._revive(elt, dead)
+        elif isinstance(target, ast.Starred):
+            self._revive(target.value, dead)
+
+    def _expr(self, node: ast.expr, donors: Dict[str, tuple],
+              dead: Set[str]) -> None:
+        if isinstance(node, (ast.Lambda, *_FUNC_TYPES)):
+            return
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in donors:
+            # reads happen BEFORE the call's donation takes effect
+            for arg in node.args:
+                self._expr(arg, donors, dead)
+            for kw in node.keywords:
+                self._expr(kw.value, donors, dead)
+            for pos in donors[node.func.id]:
+                if pos < len(node.args) and \
+                        isinstance(node.args[pos], ast.Name):
+                    dead.add(node.args[pos].id)
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in dead:
+            self._report(node, dead)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, donors, dead)
+
+    def _report(self, node: ast.Name, dead: Set[str]) -> None:
+        dead.discard(node.id)  # one report per kill, not per read
+        self._findings.append(self.finding(
+            self._ctx, node,
+            f"'{node.id}' was donated to a jitted call (donate_argnums) "
+            f"and is read again — the donated buffer is deleted after "
+            f"the call; rebind it from the call's result"))

@@ -1,0 +1,32 @@
+"""JG001 — host sync on a traced value inside a compiled function."""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from bigdl_tpu.analysis.core import (FileContext, Finding, Rule,
+                                     iter_trace_events, register)
+
+
+@register
+class HostSyncRule(Rule):
+    """``float()``/``int()``/``bool()``/``np.asarray()``/``.item()`` on a
+    traced value inside a jit/pmap/scan-compiled function forces the
+    value to the host. Under ``jit`` it is a trace-time error at best; in
+    code that sometimes runs eagerly it silently serializes the device
+    stream — the classic invisible TPU stall. Compute on-device
+    (``jnp.*``) and convert only outside the compiled region.
+    """
+
+    code = "JG001"
+    summary = ("host-sync conversion (float/int/bool/np.asarray/.item) on a "
+               "traced value inside a compiled function")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for ev in iter_trace_events(ctx):
+            if ev.kind == "host_sync":
+                yield self.finding(
+                    ctx, ev.node,
+                    f"{ev.detail} forces a traced value to the host inside "
+                    f"compiled function '{ev.qualname}'; keep the compute in "
+                    f"jnp.* and convert outside the jit boundary")

@@ -1,0 +1,78 @@
+"""JG004 — jit compilation inside a Python loop (recompilation churn)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from bigdl_tpu.analysis.core import (FileContext, Finding, Rule, _FUNC_TYPES,
+                                     _JIT_WRAPPERS, _unwrap_partial,
+                                     dotted_name, register)
+
+
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _loop_body_calls(loop: ast.AST) -> Iterator[ast.Call]:
+    """Calls lexically inside a loop body (or a comprehension's element/
+    condition expressions), not crossing a function boundary — a def
+    inside the loop compiles when *called*, not per iteration. A
+    ``jax.jit(lambda ...)`` call IS per-iteration, so the jit call
+    itself is seen even though the lambda body is skipped."""
+    if isinstance(loop, _COMPREHENSIONS):
+        stack: list = ([loop.value, loop.key]
+                       if isinstance(loop, ast.DictComp) else [loop.elt])
+        for gen in loop.generators:
+            stack.extend(gen.ifs)
+    else:
+        stack = list(loop.body) + list(getattr(loop, "orelse", []))
+        if isinstance(loop, ast.While):
+            stack.append(loop.test)  # evaluated per iteration too
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FUNC_TYPES):
+            continue
+        if isinstance(node, ast.Lambda):
+            continue  # body runs at call time, not per iteration
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class JitInLoopRule(Rule):
+    """``jax.jit(...)`` inside a ``for``/``while`` body builds a FRESH
+    jitted callable every iteration: each one has its own compile cache,
+    so every call recompiles — the canonical "my TPU is 100x slower than
+    expected" bug. Hoist the ``jax.jit`` call out of the loop (or cache
+    the wrapper keyed by its static signature, as
+    ``models/generation.generate`` does).
+    """
+
+    code = "JG004"
+    summary = ("jax.jit called inside a Python loop — a fresh wrapper per "
+               "iteration recompiles every call")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        seen = set()  # a call in nested loops reports once, not per loop
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.For, ast.While, *_COMPREHENSIONS)):
+                continue
+            if isinstance(node, ast.While):
+                kind = "while loop"
+            elif isinstance(node, ast.For):
+                kind = "for loop"
+            else:
+                kind = "comprehension"
+            for call in _loop_body_calls(node):
+                if id(call) in seen:
+                    continue
+                seen.add(id(call))
+                callee = dotted_name(call.func) or _unwrap_partial(call)
+                if callee in _JIT_WRAPPERS:
+                    yield self.finding(
+                        ctx, call,
+                        f"{callee}(...) inside a {kind} creates a fresh "
+                        f"compile cache every iteration; hoist it out of "
+                        f"the loop or cache the wrapper by its static "
+                        f"signature")

@@ -1,0 +1,291 @@
+"""JG003 — PRNG key reuse without an intervening split/fold_in."""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Sequence, Set
+
+from bigdl_tpu.analysis.core import (FileContext, Finding, Rule, _FUNC_TYPES,
+                                     dotted_name, register)
+
+# jax.random callables that CREATE keys rather than consuming entropy
+_KEY_MAKERS = {"PRNGKey", "key", "split", "fold_in", "wrap_key_data",
+               "key_data", "clone"}
+# callables that only LOOK at a key (debug prints, logging) — not draws
+_NON_CONSUMING = {"print", "str", "repr", "len", "type", "id",
+                  "isinstance", "format", "hash"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "critical",
+                "exception", "log"}
+# a name is tracked as a PRNG key if assigned from jax.random key-makers
+# or if a parameter matches this shape
+_KEY_PARAM_RE = re.compile(r"(^|_)(rng|rngs|key|keys|prng)s?$")
+
+
+def _is_random(name: str) -> bool:
+    # jax.random only: a bare ``random.`` prefix would drag the stdlib
+    # module in and flag e.g. random.choice(key) on a non-PRNG 'key'
+    return name is not None and name.startswith("jax.random.")
+
+
+def _random_member(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+@dataclass
+class _State:
+    """Per-path key bookkeeping: consumption counts by name.
+
+    ``tracked`` names *might* be keys (matched the parameter-name
+    heuristic); ``definite`` names were assigned from a jax.random key
+    maker in this scope. Generic (non-jax.random) calls only count as
+    consumption for definite keys — a key-ish *name* passed twice to
+    e.g. ``sorted(xs, key=key)`` is not PRNG reuse."""
+
+    counts: Dict[str, int] = field(default_factory=dict)
+    tracked: Set[str] = field(default_factory=set)
+    definite: Set[str] = field(default_factory=set)
+
+    def copy(self) -> "_State":
+        return _State(dict(self.counts), set(self.tracked),
+                      set(self.definite))
+
+    def merge(self, *others: "_State") -> None:
+        for o in others:
+            self.tracked |= o.tracked
+            self.definite |= o.definite
+            for k, v in o.counts.items():
+                self.counts[k] = max(self.counts.get(k, 0), v)
+
+
+@register
+class KeyReuseRule(Rule):
+    """Passing the same PRNG key to two ``jax.random.*`` draws (or two
+    helpers) without an intervening ``split``/``fold_in`` makes the draws
+    perfectly correlated — dropout masks repeat across layers, sampled
+    tokens repeat across steps — and the program still "works", just
+    wrongly. Split first: ``key, sub = jax.random.split(key)`` and give
+    every consumer its own subkey.
+    """
+
+    code = "JG003"
+    summary = ("same PRNG key consumed by >=2 draws with no intervening "
+               "split/fold_in, or ad-hoc PRNGKey(seed arithmetic) streams")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not self._imports_jax(ctx.tree):
+            return  # key-ish names in a jax-free file are not PRNG keys
+        self._findings: List[Finding] = []
+        self._seen: Set[int] = set()
+        self._ctx = ctx
+        for fn in ctx.jit_index.functions:
+            self._check_fn(fn)
+        yield from self._findings
+        yield from self._check_adhoc_streams(ctx)
+
+    def _check_adhoc_streams(self, ctx: FileContext) -> Iterator[Finding]:
+        """``PRNGKey(seed + n*7919)``-style derivation: two such arithmetic
+        families in one program can land on the SAME integer for some
+        counter pair, silently correlating their streams. Keys derived
+        per-call belong in ``fold_in(base_key, counter)`` (collision-free
+        by construction)."""
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = dotted_name(node.func)
+            if not (_is_random(name or "")
+                    and _random_member(name) in ("PRNGKey", "key")):
+                continue
+            if isinstance(node.args[0], ast.BinOp):
+                yield self.finding(
+                    ctx, node,
+                    f"{name}(<arithmetic>) derives a key stream by seed "
+                    f"arithmetic — two such families can collide on the "
+                    f"same integer and correlate; derive per-call keys "
+                    f"with jax.random.fold_in(base_key, counter)")
+
+    @staticmethod
+    def _imports_jax(tree: ast.Module) -> bool:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                if any(a.name.split(".")[0] == "jax" for a in node.names):
+                    return True
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "jax":
+                    return True
+        return False
+
+    # ------------------------------------------------------------------
+    def _check_fn(self, fn) -> None:
+        state = _State()
+        for a in ([*getattr(fn.args, "posonlyargs", []), *fn.args.args,
+                   *fn.args.kwonlyargs]):
+            if _KEY_PARAM_RE.search(a.arg):
+                state.tracked.add(a.arg)
+        self._qual = self._ctx.jit_index.qualname(fn)
+        self._block(fn.body, state)
+
+    def _block(self, stmts: Sequence[ast.stmt], state: _State) -> bool:
+        """Process statements in order; True if the block terminates
+        (return/raise/break/continue) so callers skip merging its exit
+        state."""
+        for stmt in stmts:
+            if self._stmt(stmt, state):
+                return True
+        return False
+
+    def _stmt(self, stmt: ast.stmt, state: _State) -> bool:
+        if isinstance(stmt, _FUNC_TYPES):
+            return False  # nested defs get their own _check_fn pass
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            if getattr(stmt, "value", None) is not None:
+                self._expr(stmt.value, state)
+            if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                self._expr(stmt.exc, state)
+            return True
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            made_key = False
+            if value is not None:
+                self._expr(value, state)
+                made_key = self._makes_key(value)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for tgt in targets:
+                self._bind(tgt, made_key, state)
+            return False
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, state)
+            s1, s2 = state.copy(), state.copy()
+            t1 = self._block(stmt.body, s1)
+            t2 = self._block(stmt.orelse, s2)
+            if t1 and t2:
+                return True
+            if t1:
+                self._replace(state, s2)
+            elif t2:
+                self._replace(state, s1)
+            else:
+                self._replace(state, s1)
+                state.merge(s2)
+            return False
+        if isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                self._expr(stmt.iter, state)
+            else:
+                self._expr(stmt.test, state)
+            # run the body twice: the second pass sees first-iteration
+            # state, catching reuse ACROSS iterations
+            for _ in range(2):
+                s1 = state.copy()
+                if isinstance(stmt, ast.For):
+                    self._bind(stmt.target, self._makes_key(stmt.iter), s1)
+                self._block(stmt.body, s1)
+                state.merge(s1)
+            self._block(stmt.orelse, state)
+            return False
+        if isinstance(stmt, ast.Try):
+            s1 = state.copy()
+            self._block(stmt.body, s1)
+            state.merge(s1)
+            for handler in stmt.handlers:
+                sh = state.copy()
+                self._block(handler.body, sh)
+                state.merge(sh)
+            self._block(stmt.orelse, state)
+            self._block(stmt.finalbody, state)
+            return False
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._expr(item.context_expr, state)
+            return self._block(stmt.body, state)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, state)
+        return False
+
+    def _bind(self, target: ast.expr, made_key: bool, state: _State) -> None:
+        if isinstance(target, ast.Name):
+            state.counts[target.id] = 0
+            # only key-maker results are tracked on rebind: a key-ish NAME
+            # bound to a non-key value (cache_key = str(...)) drops out
+            if made_key:
+                state.tracked.add(target.id)
+                state.definite.add(target.id)
+            else:
+                state.tracked.discard(target.id)
+                state.definite.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, made_key, state)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, made_key, state)
+
+    def _makes_key(self, value: ast.expr) -> bool:
+        if isinstance(value, ast.Call):
+            name = dotted_name(value.func)
+            return (_is_random(name or "")
+                    and _random_member(name) in _KEY_MAKERS)
+        return False
+
+    # -- expressions ----------------------------------------------------
+    def _expr(self, node: ast.expr, state: _State) -> None:
+        if isinstance(node, (ast.Lambda, *_FUNC_TYPES)):
+            return
+        if isinstance(node, ast.Call):
+            # bare-Name tracked keys passed as arguments = one consumption;
+            # key-DERIVING calls (split/fold_in) are exempt — fold_in(key,
+            # i) with distinct i is the recommended multi-stream idiom, not
+            # a draw from the key. Generic (non-jax.random) calls consume
+            # only DEFINITE keys: a merely key-named parameter handed to
+            # sorted(xs, key=key) twice is not PRNG reuse.
+            name = dotted_name(node.func)
+            is_rand = _is_random(name or "")
+            derives = is_rand and _random_member(name) in _KEY_MAKERS
+            looks_only = (name in _NON_CONSUMING
+                          or (name is not None
+                              and name.rsplit(".", 1)[-1] in _LOG_METHODS))
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in state.tracked:
+                    if derives or looks_only:
+                        continue
+                    if is_rand or arg.id in state.definite:
+                        self._consume(arg.id, node, state)
+                else:
+                    self._expr(arg, state)
+            self._expr_children(node.func, state)
+            return
+        if isinstance(node, ast.IfExp):
+            self._expr(node.test, state)
+            s1, s2 = state.copy(), state.copy()
+            self._expr(node.body, s1)
+            self._expr(node.orelse, s2)
+            self._replace(state, s1)
+            state.merge(s2)
+            return
+        self._expr_children(node, state)
+
+    @staticmethod
+    def _replace(state: _State, other: _State) -> None:
+        state.counts, state.tracked = other.counts, other.tracked
+        state.definite = other.definite
+
+    def _expr_children(self, node: ast.AST, state: _State) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, state)
+
+    def _consume(self, name: str, call: ast.Call, state: _State) -> None:
+        state.counts[name] = state.counts.get(name, 0) + 1
+        if state.counts[name] >= 2 and id(call) not in self._seen:
+            self._seen.add(id(call))
+            callee = dotted_name(call.func) or "a call"
+            self._findings.append(self.finding(
+                self._ctx, call,
+                f"PRNG key '{name}' consumed again by {callee} in "
+                f"'{self._qual}' with no intervening split/fold_in — "
+                f"draws from a reused key are identical; use "
+                f"'{name}, sub = jax.random.split({name})'"))

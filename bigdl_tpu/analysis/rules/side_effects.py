@@ -1,0 +1,64 @@
+"""JG002 — trace-time side effects inside compiled functions."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from bigdl_tpu.analysis.core import (FileContext, Finding, Rule, dotted_name,
+                                     iter_own_statements, register)
+
+_LOGGER_NAMES = {"logging", "logger", "log", "LOG", "LOGGER", "_log",
+                 "_logger"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "critical",
+                "exception", "log"}
+
+
+@register
+class TraceSideEffectRule(Rule):
+    """``print``/``logging``/``warnings.warn``/``global`` mutation inside
+    a compiled function runs at *trace* time, not run time: it fires once
+    per compilation (not per step), silently stops firing on cache hits,
+    and global mutation bakes a stale value into the compiled program.
+    Use ``jax.debug.print``/``jax.debug.callback`` for runtime effects,
+    or hoist the side effect out of the traced region.
+    """
+
+    code = "JG002"
+    summary = ("print/logging/global mutation under jit runs at trace time, "
+               "not run time (use jax.debug.print)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        idx = ctx.jit_index
+        for fn in idx.functions:
+            if not idx.is_compiled(fn):
+                continue
+            qual = idx.qualname(fn)
+            for node in iter_own_statements(fn):
+                if isinstance(node, ast.Global):
+                    yield self.finding(
+                        ctx, node,
+                        f"'global {', '.join(node.names)}' inside compiled "
+                        f"function '{qual}': the mutation happens once at "
+                        f"trace time and is invisible to later calls")
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                effect = None
+                if name == "print":
+                    effect = "print()"
+                elif name == "warnings.warn":
+                    effect = "warnings.warn()"
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _LOG_METHODS
+                      and isinstance(node.func.value, ast.Name)
+                      and node.func.value.id in _LOGGER_NAMES):
+                    effect = f"{node.func.value.id}.{node.func.attr}()"
+                if effect is not None:
+                    yield self.finding(
+                        ctx, node,
+                        f"{effect} inside compiled function '{qual}' fires "
+                        f"at trace time only (once per compile, never on "
+                        f"cache hits); use jax.debug.print or move it out "
+                        f"of the traced region")

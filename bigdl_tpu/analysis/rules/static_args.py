@@ -1,0 +1,139 @@
+"""JG005 — invalid or non-hashable static-argument declarations."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from bigdl_tpu.analysis.core import (FileContext, Finding, Rule,
+                                     _JIT_WRAPPERS, _positional_params,
+                                     _unwrap_partial, dotted_name,
+                                     is_mutable_default, register)
+
+
+def _static_decls(call: ast.Call) -> Iterator[Tuple[str, ast.expr]]:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnames"):
+            yield kw.arg, kw.value
+
+
+def _literal_values(node: ast.expr) -> Optional[List[object]]:
+    """Constant(s) out of an int/str/tuple/list literal, else None."""
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[object] = []
+        for elt in node.elts:
+            if not isinstance(elt, ast.Constant):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+def _default_of(fn: ast.AST, param: str) -> Optional[ast.expr]:
+    a = fn.args
+    pos = list(getattr(a, "posonlyargs", [])) + list(a.args)
+    for arg, default in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        if arg.arg == param:
+            return default
+    for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+        if arg.arg == param and default is not None:
+            return default
+    return None
+
+
+@register
+class StaticArgsRule(Rule):
+    """A ``static_argnums``/``static_argnames`` declaration that names a
+    missing parameter or an out-of-range index silently does nothing —
+    the argument is traced anyway, and every distinct value either
+    recompiles (hashable) or crashes (unhashable) at the call site far
+    from the declaration. A static parameter whose default is a mutable
+    literal (``[]``/``{}``) is guaranteed unhashable the first time the
+    default is used. Declarations must name real, hashable parameters.
+    """
+
+    code = "JG005"
+    summary = ("static_argnums/static_argnames names a missing parameter, "
+               "out-of-range index, or unhashable default")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for call, fn in self._wrapper_calls(ctx):
+            yield from self._check_decl(ctx, call, fn)
+
+    # ------------------------------------------------------------------
+    def _wrapper_calls(self, ctx: FileContext):
+        """(jit-wrapper Call, wrapped FunctionDef-or-None) pairs: both the
+        decorator form and call-site wrapping of a resolvable name."""
+        idx = ctx.jit_index
+        for fn in idx.functions:
+            for dec in fn.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                callee = dotted_name(dec.func) or _unwrap_partial(dec)
+                if callee in _JIT_WRAPPERS:
+                    yield dec, fn
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee not in _JIT_WRAPPERS or not node.args:
+                continue
+            target = node.args[0]
+            fn = None
+            if isinstance(target, ast.Name):
+                matches = idx._resolve_name(target.id, node)
+                fn = matches[0] if len(matches) == 1 else None
+            yield node, fn
+
+    def _check_decl(self, ctx: FileContext, call: ast.Call,
+                    fn) -> Iterator[Finding]:
+        for kind, value in _static_decls(call):
+            values = _literal_values(value)
+            if values is None:
+                continue  # computed declaration: out of scope
+            if fn is None:
+                continue  # unresolvable target (method/attribute)
+            pos = _positional_params(fn)
+            has_vararg = fn.args.vararg is not None
+            has_kwarg = fn.args.kwarg is not None
+            names: Set[str] = set(pos) | {a.arg for a in fn.args.kwonlyargs}
+            for v in values:
+                if kind == "static_argnums":
+                    if not isinstance(v, int) or isinstance(v, bool):
+                        yield self.finding(
+                            ctx, value, f"static_argnums entry {v!r} is not "
+                            f"an int")
+                        continue
+                    if v >= len(pos) and not has_vararg:
+                        yield self.finding(
+                            ctx, value,
+                            f"static_argnums index {v} is out of range for "
+                            f"'{fn.name}' ({len(pos)} positional "
+                            f"parameter(s)) — the declaration is dead and "
+                            f"the argument is traced anyway")
+                        continue
+                    param = pos[v] if v < len(pos) else None
+                else:
+                    if not isinstance(v, str):
+                        yield self.finding(
+                            ctx, value, f"static_argnames entry {v!r} is "
+                            f"not a string")
+                        continue
+                    if v not in names and not has_kwarg:
+                        yield self.finding(
+                            ctx, value,
+                            f"static_argnames {v!r} is not a parameter of "
+                            f"'{fn.name}' — the declaration is dead and the "
+                            f"argument is traced anyway")
+                        continue
+                    param = v
+                if param is not None:
+                    default = _default_of(fn, param)
+                    if default is not None and is_mutable_default(default):
+                        yield self.finding(
+                            ctx, default,
+                            f"static parameter '{param}' of '{fn.name}' has "
+                            f"a mutable (unhashable) default — jit static "
+                            f"args must be hashable")

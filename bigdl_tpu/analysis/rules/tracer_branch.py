@@ -1,0 +1,33 @@
+"""JG006 — Python branching on tracer values inside a compiled function."""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from bigdl_tpu.analysis.core import (FileContext, Finding, Rule,
+                                     iter_trace_events, register)
+
+
+@register
+class TracerBranchRule(Rule):
+    """``if``/``while``/``assert`` on a traced value inside a compiled
+    function raises ``TracerBoolConversionError`` at trace time (or, for
+    shape-polymorphic code, recompiles per value). Branch with
+    ``jax.lax.cond``/``jax.lax.select``/``jnp.where`` instead, or hoist
+    the decision out of the compiled region. Python branches on *static*
+    values (closure config, ``.shape``/``.ndim``/``len()`` results,
+    ``static_argnames`` parameters) are fine and not flagged.
+    """
+
+    code = "JG006"
+    summary = ("Python if/while/assert on a traced value inside a compiled "
+               "function (use lax.cond/jnp.where)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for ev in iter_trace_events(ctx):
+            if ev.kind == "tracer_branch":
+                yield self.finding(
+                    ctx, ev.node,
+                    f"Python branch on traced value ('{ev.detail}') inside "
+                    f"compiled function '{ev.qualname}'; use jax.lax.cond / "
+                    f"jnp.where, or make the operand static")

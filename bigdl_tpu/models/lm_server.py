@@ -71,6 +71,7 @@ class LMServer:
         self.sampling = dict(temperature=temperature, top_k=top_k,
                              top_p=top_p, greedy=greedy, eos_id=eos_id)
         self._seed = seed
+        self._base_key = None  # built lazily (jax imports on first decode)
         self._n_batches = 0
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         # requests displaced from a batch because their length differed:
@@ -189,7 +190,11 @@ class LMServer:
         rows = [req.ids for req in batch]
         rows += [rows[0]] * (b - len(rows))
         prompt = np.asarray(rows, np.float32)
-        key = jax.random.PRNGKey(self._seed + self._n_batches)
+        # fold_in, not PRNGKey(seed + n): seed-arithmetic streams from two
+        # servers (seeds s, s+1) would share every key one batch apart
+        if self._base_key is None:
+            self._base_key = jax.random.PRNGKey(self._seed)
+        key = jax.random.fold_in(self._base_key, self._n_batches)
         out = np.asarray(generate(self.model, prompt, self.max_new_tokens,
                                   key=key, **self.sampling)).astype(int)
         self._n_batches += 1

@@ -93,6 +93,14 @@ class ContinuousLMServer:
                              top_p=top_p, greedy=greedy)
         self.eos_id = eos_id
         self._seed = seed
+        # Disjoint key streams, collision-free by construction: the old
+        # ad-hoc arithmetic (seed + n_admitted*7919 + 1 for admissions,
+        # seed + steps*31 + 17 for decode blocks) lands both families on
+        # the SAME PRNGKey for some (n, steps) pair — e.g. admission 10
+        # and step 2554 — correlating an admitted token draw with a whole
+        # decode block (found by graftlint JG003).
+        self._admit_key = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
+        self._step_key = jax.random.fold_in(jax.random.PRNGKey(seed), 1)
         self._steps = 0
         self._n_served = 0
         self._n_admitted = 0
@@ -262,8 +270,7 @@ class ContinuousLMServer:
             # admits can happen between completions, and identical prompts
             # sampled under a reused key would correlate perfectly)
             self._n_admitted += 1
-            key = jax.random.PRNGKey(self._seed + self._n_admitted * 7919
-                                     + 1)
+            key = jax.random.fold_in(self._admit_key, self._n_admitted)
             tok = int(sample_token(lp, key, **self.sampling)[0])
             # peek, insert, THEN pop: an insert failure must not leak the
             # slot. (The insert donates self.buffers; a RUNTIME failure
@@ -317,7 +324,7 @@ class ContinuousLMServer:
                 continue
             # one decode block for every slot (dead rows compute garbage)
             self._steps += 1
-            key = jax.random.PRNGKey(self._seed + self._steps * 31 + 17)
+            key = jax.random.fold_in(self._step_key, self._steps)
             toks, self.buffers = self._step()(
                 self.params, self.buffers,
                 jnp.asarray(self._last_tok), key)

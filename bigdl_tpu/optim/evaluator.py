@@ -65,6 +65,28 @@ def evaluate_batches(fwd: Callable, params, buffers,
     # evaluations (the training loop's validation path does).
     cache_key = (id(fwd),) + tuple(id(m) for m in v_methods)
     scorer = (cache or {}).get(cache_key)
+    scorer_cached = scorer is not None
+    if fast_ok and scorer is None:
+        # built ONCE before the batch loop (graftlint JG004: a jax.jit
+        # call inside the loop — even lazily guarded — is the
+        # recompile-churn shape; tracing still happens at first use).
+        # The CACHE insert stays lazy (first fast-path batch): evicting a
+        # valid entry for a scorer that never runs would cost the next
+        # evaluation its cached trace.
+        def scorer_fn(p, b, x, y, a):
+            out = fwd(p, b, x)
+            av, ac = a
+            # values accumulate f32 (per-batch sums are f32 device
+            # results anyway); counts accumulate int32 — EXACT to
+            # 2^31 records where an f32 count goes wrong past 2^24
+            pairs = [m.batch_result(out, y) for m in v_methods]
+            vs = jnp.stack([jnp.asarray(v).astype(jnp.float32)
+                            for v, _ in pairs])
+            cs = jnp.stack([jnp.asarray(c).astype(jnp.int32)
+                            for _, c in pairs])
+            return av + vs, ac + cs
+
+        scorer = jax.jit(scorer_fn, donate_argnums=(4,))
     acc = None
     for item in batches:
         batch = _as_minibatch(item)
@@ -74,24 +96,10 @@ def evaluate_batches(fwd: Callable, params, buffers,
             full_bs = n
         labels = jnp.asarray(batch.labels)
         if fast_ok and sliceable and n == full_bs:
-            if scorer is None:
-                def scorer_fn(p, b, x, y, a):
-                    out = fwd(p, b, x)
-                    av, ac = a
-                    # values accumulate f32 (per-batch sums are f32 device
-                    # results anyway); counts accumulate int32 — EXACT to
-                    # 2^31 records where an f32 count goes wrong past 2^24
-                    pairs = [m.batch_result(out, y) for m in v_methods]
-                    vs = jnp.stack([jnp.asarray(v).astype(jnp.float32)
-                                    for v, _ in pairs])
-                    cs = jnp.stack([jnp.asarray(c).astype(jnp.int32)
-                                    for _, c in pairs])
-                    return av + vs, ac + cs
-
-                scorer = jax.jit(scorer_fn, donate_argnums=(4,))
-                if cache is not None:
-                    cache.clear()  # fwd/methods changed: old entry is stale
-                    cache[cache_key] = scorer
+            if cache is not None and not scorer_cached:
+                cache.clear()  # fwd/methods changed: old entry is stale
+                cache[cache_key] = scorer
+                scorer_cached = True
             if acc is None:
                 acc = (jnp.zeros((len(v_methods),), jnp.float32),
                        jnp.zeros((len(v_methods),), jnp.int32))

@@ -4,7 +4,20 @@
 # command. Usage:
 #   ./scripts/bigdl-tpu.sh -- python -m bigdl_tpu.apps.lenet train -b 256
 #   ./scripts/bigdl-tpu.sh -- bigdl-tpu-perf --model resnet50
+#   ./scripts/bigdl-tpu.sh lint [paths... --select/--ignore/--format ...]
 set -euo pipefail
+
+# --- lint subcommand: graftlint, the AST-based JAX-hazard linter
+#     (docs/ANALYSIS.md). With no path arguments the CLI itself defaults
+#     to the tier-1 self-lint gate tree (bigdl_tpu/ + scripts/, resolved
+#     from the package location), so flags-only invocations like
+#     `lint --format json` cover the same tree.
+if [[ "${1:-}" == "lint" ]]; then
+  shift
+  root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+  export PYTHONPATH="$root${PYTHONPATH:+:$PYTHONPATH}"
+  exec python -m bigdl_tpu.analysis "$@"
+fi
 
 # --- compilation cache: first compile of a big model is 20-40s; persist it
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-${TMPDIR:-/tmp}/bigdl_tpu_jax_cache}"

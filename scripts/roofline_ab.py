@@ -144,6 +144,7 @@ def bench_layout_ab(batch: int):
             gfn = jax.grad(loss, argnums=(0, 1))
             # device-side chain (dx has x's shape: s=1, cin==cout), one
             # dispatch per timing — tunnel RTT amortised away
+            # graftlint: ignore[JG004] -- one compile per benchmarked layout by design (A/B sweep, not a hot loop)
             g = jax.jit(lambda xx, kk: jax.lax.fori_loop(
                 0, reps, lambda i, t: gfn(t, kk)[0], xx))
             total += _timed_chain(g, lambda o: o, x, k, iters=3) / reps

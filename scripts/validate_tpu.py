@@ -69,9 +69,11 @@ def check_flash_attention(jax):
                 return jnp.einsum("bhqk,bkhd->bqhd", p,
                                   v.astype(jnp.float32)).astype(q.dtype)
 
+            # graftlint: ignore[JG004] -- correctness sweep: each (dtype, causal) config compiles and runs exactly once
             out_flash = jax.jit(
                 lambda q, k, v: flash_attention(q, k, v, causal=causal,
                                                 scale=scale))(q, k, v)
+            # graftlint: ignore[JG004] -- correctness sweep: each (dtype, causal) config compiles and runs exactly once
             out_ref = jax.jit(ref)(q, k, v)
             err = float(jnp.max(jnp.abs(out_flash.astype(jnp.float32)
                                         - out_ref.astype(jnp.float32))))
@@ -87,7 +89,9 @@ def check_flash_attention(jax):
             def loss_ref(q):
                 return jnp.sum(ref(q, k, v).astype(jnp.float32) ** 2)
 
+            # graftlint: ignore[JG004] -- correctness sweep: each (dtype, causal) config compiles and runs exactly once
             g_flash = jax.jit(jax.grad(loss_flash))(q)
+            # graftlint: ignore[JG004] -- correctness sweep: each (dtype, causal) config compiles and runs exactly once
             g_ref = jax.jit(jax.grad(loss_ref))(q)
             gerr = float(jnp.max(jnp.abs(g_flash.astype(jnp.float32)
                                          - g_ref.astype(jnp.float32))))

@@ -1,0 +1,9 @@
+"""JG001 positive: host-sync conversion on a traced value under jit."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def loss_scalar(x):
+    # float() on a traced reduction forces a device->host transfer
+    return float(jnp.sum(x * x))

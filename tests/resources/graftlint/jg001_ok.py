@@ -1,0 +1,17 @@
+"""JG001 near-miss: host conversions that are NOT hazards.
+
+- float() on static shape metadata inside jit (no device value involved)
+- float() on a device value in an EAGER function (legal sync point)
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def normalized(x):
+    scale = 1.0 / float(x.shape[0])  # shape is static metadata, not a tracer
+    return jnp.sum(x) * scale
+
+
+def eager_loss(x):
+    return float(jnp.sum(x * x))  # outside jit: the sync is the point

@@ -1,0 +1,9 @@
+"""JG002 positive: print inside a compiled function fires at trace time."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    print("loss is", x)  # runs ONCE at trace, never on later calls
+    return jnp.sum(x)

@@ -1,0 +1,17 @@
+"""JG002 near-miss: the sanctioned runtime-effect forms.
+
+- jax.debug.print is staged into the program (fires per call)
+- print in an eager helper is ordinary Python
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("loss is {}", jnp.sum(x))
+    return jnp.sum(x)
+
+
+def report(loss):
+    print("loss is", loss)

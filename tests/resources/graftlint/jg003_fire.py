@@ -1,0 +1,8 @@
+"""JG003 positive: one key feeding two draws — identical randomness."""
+import jax
+
+
+def sample_pair(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # same key: b is correlated with a
+    return a + b

@@ -1,0 +1,34 @@
+"""JG003 near-misses that must NOT fire.
+
+- split between consumptions (the correct idiom)
+- one consumption per *disjoint* branch (at most one executes)
+- early return before the second consumption
+"""
+import jax
+
+
+def sample_pair(key):
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (4,))
+    key, sub = jax.random.split(key)
+    b = jax.random.uniform(sub, (4,))
+    return a + b
+
+
+def sample_one(key, uniform):
+    if uniform:
+        return jax.random.uniform(key, (4,))
+    return jax.random.normal(key, (4,))
+
+
+def maybe_sample(key, greedy, logits):
+    if greedy:
+        out = jax.random.categorical(key, logits)
+        return out
+    return jax.random.categorical(key, logits * 0.5)
+
+
+def derive_streams(key, n):
+    # fold_in DERIVES per-counter streams — the rule's own recommended
+    # idiom must not count as consumption
+    return [jax.random.fold_in(key, i) for i in range(n)]

@@ -1,0 +1,9 @@
+"""JG004 positive: a fresh jit wrapper per loop iteration."""
+import jax
+
+
+def train(loss_fn, params, batches):
+    for batch in batches:
+        step = jax.jit(loss_fn)  # new wrapper = new cache: recompiles
+        params = step(params, batch)
+    return params

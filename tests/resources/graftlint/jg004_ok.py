@@ -1,0 +1,23 @@
+"""JG004 near-misses: the hoisted idiom, and a def inside the loop.
+
+A function *defined* in the loop body that jits when CALLED is not a
+per-iteration compile (the wrapper is built on demand, typically cached
+by signature) — the rule only flags jit calls lexically in the loop.
+"""
+import jax
+
+
+def train(loss_fn, params, batches):
+    step = jax.jit(loss_fn)  # built once, reused every iteration
+    for batch in batches:
+        params = step(params, batch)
+    return params
+
+
+def build_steps(loss_fn, configs):
+    builders = []
+    for cfg in configs:
+        def make(cfg=cfg):
+            return jax.jit(lambda p, b: loss_fn(p, b, cfg))
+        builders.append(make)
+    return builders

@@ -1,0 +1,11 @@
+"""JG005 positive: static_argnames naming a parameter that doesn't exist."""
+import jax
+
+
+def forward(params, x):
+    return params["w"] @ x
+
+
+# 'mode' is not a parameter of forward: the declaration is dead and the
+# argument would be traced anyway
+fast_forward = jax.jit(forward, static_argnames=("mode",))

@@ -1,0 +1,26 @@
+"""JG005 near-misses: valid declarations that must not fire.
+
+- static_argnames matching a real (keyword-only) parameter
+- static_argnums in range
+- a **kwargs catch-all that legitimately absorbs any static name
+"""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("training",))
+def apply(params, x, *, training=False):
+    return x if training else x * 2
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def scale(x, y, factor):
+    return x * factor + y
+
+
+def flexible(x, **options):
+    return x
+
+
+fast_flexible = jax.jit(flexible, static_argnames=("anything",))

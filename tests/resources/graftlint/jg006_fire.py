@@ -1,0 +1,10 @@
+"""JG006 positive: Python branch on a traced value under jit."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def relu_or_neg(x):
+    if x > 0:  # TracerBoolConversionError at trace time
+        return x
+    return -jnp.abs(x)

@@ -1,0 +1,29 @@
+"""JG006 near-misses: static branches inside jit that must not fire.
+
+- branching on shape metadata (static under trace)
+- branching on closure config (a Python bool baked in at trace time)
+- branching on a static_argnames parameter
+- the traced-value branch expressed correctly via jnp.where
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def build(use_bias):
+    @jax.jit
+    def apply(x, b):
+        if use_bias:          # closure config: static at trace time
+            x = x + b
+        if x.ndim > 2:        # shape metadata: static
+            x = x.reshape(x.shape[0], -1)
+        return jnp.where(x > 0, x, -x)   # traced branch done right
+    return apply
+
+
+@functools.partial(jax.jit, static_argnames=("causal",))
+def attend(scores, causal):
+    if causal:                # declared static: a real Python bool
+        scores = jnp.tril(scores)
+    return scores

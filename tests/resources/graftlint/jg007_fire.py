@@ -1,0 +1,10 @@
+"""JG007 positive: reading a buffer after donating it to a jitted call."""
+import jax
+
+
+def train_step(step_fn, params, batch):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    new_params = step(params, batch)
+    # params' buffer was donated to XLA and deleted by the call above
+    delta = jax.tree_util.tree_map(lambda a, b: a - b, new_params, params)
+    return new_params, delta

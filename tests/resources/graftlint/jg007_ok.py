@@ -1,0 +1,20 @@
+"""JG007 near-misses: donation used correctly.
+
+- the rebind idiom (params = step(params, ...)) — old name never read
+- reads BEFORE the donating call are fine
+"""
+import jax
+
+
+def train(step_fn, params, batches):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    for batch in batches:
+        params = step(params, batch)   # rebound from the result each time
+    return params
+
+
+def train_with_norm(step_fn, norm_fn, params, batch):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    norm = norm_fn(params)             # read happens before donation
+    params = step(params, batch)
+    return params, norm

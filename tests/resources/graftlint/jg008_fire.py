@@ -1,0 +1,10 @@
+"""JG008 positive: mutable default shared across constructions."""
+
+
+class Sequential:
+    def __init__(self, layers=[]):  # ONE list shared by every instance
+        self.layers = layers
+
+    def add(self, layer):
+        self.layers.append(layer)
+        return self

@@ -1,0 +1,12 @@
+"""JG008 near-misses: immutable defaults and the None idiom."""
+
+
+class Sequential:
+    def __init__(self, layers=None, shape=(1, 1), name="seq"):
+        self.layers = list(layers) if layers is not None else []
+        self.shape = shape
+        self.name = name
+
+    def add(self, layer):
+        self.layers.append(layer)
+        return self
