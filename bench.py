@@ -82,12 +82,15 @@ def _init_jax(platform=None, retries=3):
             delay *= 2
 
 
-def _timed_loop(step, state, budget_s, max_steps, batch):
+def _timed_loop(step, state, budget_s, max_steps, batch, step_hist=None):
     """Run warmup + timed steps under a wall-clock budget; return imgs/sec.
 
     Warmup forces a device->host scalar fetch after EVERY step so a wedged
     transfer path fails inside the (killable) worker budget rather than
-    silently queueing async work.
+    silently queueing async work. ``step_hist`` (a telemetry Histogram)
+    receives per-step wall-clock observations — chunk time / steps, the
+    chunk-end force() being the sync point — so the emitted JSON carries a
+    step-time distribution, not just the headline mean.
     """
     force = state.pop("_force")
     t_start = time.monotonic()
@@ -106,9 +109,12 @@ def _timed_loop(step, state, budget_s, max_steps, batch):
     over_budget = False
     while done < max_steps and not over_budget:
         n = min(chunk, max_steps - done)
+        t_chunk = time.monotonic()
+        n_chunk = 0
         for _ in range(n):
             state = step(state)
             done += 1
+            n_chunk += 1
             # per-dispatch budget check: at large K each dispatch is
             # seconds of device work, so a per-chunk check could commit
             # to minutes past the budget and get the worker killed
@@ -116,6 +122,10 @@ def _timed_loop(step, state, budget_s, max_steps, batch):
                 over_budget = True
                 break
         force(state)
+        if step_hist is not None and n_chunk:
+            per_step = (time.monotonic() - t_chunk) / n_chunk
+            for _ in range(n_chunk):
+                step_hist.observe(per_step)
         elapsed = time.monotonic() - t0
         log(f"timed {done}/{max_steps} steps, {elapsed:.1f}s")
         if over_budget:
@@ -321,17 +331,30 @@ def worker_train(name, batch, steps, budget_s, precision="bf16",
         p, b, o = st["s"]
         return {"s": jstep(p, b, o, data, labels)}
 
-    rps = _timed_loop(step, state, budget_s, steps, batch * K)
-    return rps * rec_factor, model
+    # step-time distribution for the BENCH JSON (telemetry is jax-free and
+    # cheap: one histogram observe per timed step). Private registry: the
+    # worker is single-purpose, no global scrape to feed.
+    from bigdl_tpu.telemetry import MetricsRegistry, instruments
+    step_hist = instruments(MetricsRegistry()).bench_step_seconds
+    rps = _timed_loop(step, state, budget_s, steps, batch * K,
+                      step_hist=step_hist)
+    telem = {
+        # per-DISPATCH wall-clock summary (each dispatch = K fused steps)
+        "step_seconds": step_hist.summary(),
+        "steps_per_dispatch": K,
+        "records_per_sec": round(rps * rec_factor, 2),
+    }
+    return rps * rec_factor, model, telem
 
 
 def run_worker(args):
     """Execute one attempt and print its result JSON (worker protocol:
     last stdout line is the JSON)."""
     name = args.worker
-    rps, model = worker_train(name, args.batch, args.steps, args.budget,
-                              precision=args.precision,
-                              platform=args.platform or None)
+    rps, model, telem = worker_train(name, args.batch, args.steps,
+                                     args.budget,
+                                     precision=args.precision,
+                                     platform=args.platform or None)
     if name in _FWD_MACS:
         flops = 6 * _FWD_MACS[name]
         mfu = rps * flops / V5E_BF16_FLOPS
@@ -378,6 +401,9 @@ def run_worker(args):
             "vs_baseline": round(rps / LENET_BASELINE_RPS, 2),
             "batch": args.batch,
         }
+    # step-time histogram summary + throughput: future rounds read a perf
+    # TRAJECTORY with breakdowns, not just headline numbers
+    out["telemetry"] = telem
     print(json.dumps(out), flush=True)
 
 

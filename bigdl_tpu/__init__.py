@@ -33,12 +33,13 @@ from bigdl_tpu import utils
 from bigdl_tpu import visualization
 from bigdl_tpu import interop
 from bigdl_tpu import ml
+from bigdl_tpu import telemetry
 
 __version__ = "0.1.0"
 
 __all__ = [
     "Engine", "Table", "T", "Tensor",
     "nn", "optim", "dataset", "parallel", "utils", "visualization", "interop",
-    "ml",
+    "ml", "telemetry",
     "__version__",
 ]

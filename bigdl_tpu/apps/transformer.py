@@ -507,7 +507,7 @@ def serve_cmd(argv) -> None:
                           eos_id=args.eosId, seed=args.seed)
     httpd = make_http_server(server, args.host, args.port, tokenizer=tok)
     print(f"serving on http://{args.host}:{httpd.server_address[1]} "
-          f"(POST /generate, GET /health)", file=sys.stderr)
+          f"(POST /generate, GET /health, GET /metrics)", file=sys.stderr)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

@@ -36,6 +36,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from bigdl_tpu.telemetry import get_registry, instruments, span
+
 
 @dataclass
 class _Request:
@@ -44,6 +46,7 @@ class _Request:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[List[int]] = None  # continuation ids (1-based)
     error: Optional[str] = None
+    t_submit: float = 0.0               # perf_counter at submit (batch wait)
 
 
 class LMServer:
@@ -61,9 +64,14 @@ class LMServer:
                  max_new_tokens: int = 64,
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 0.0, greedy: bool = False,
-                 eos_id: Optional[int] = None, seed: int = 0):
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 registry=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        # telemetry (docs/OBSERVABILITY.md): batch size / batch wait /
+        # batches served + queue depth, scraped via GET /metrics
+        self.registry = registry if registry is not None else get_registry()
+        self._tm = instruments(self.registry)
         self.model = model
         self.max_batch = max_batch
         self.batch_timeout = batch_timeout_ms / 1000.0
@@ -99,12 +107,19 @@ class LMServer:
             raise ValueError(f"max_new_tokens {max_new} exceeds the "
                              f"server's decode budget {self.max_new_tokens}")
         req = _Request(ids, max_new)
+        req.t_submit = _now()
         self._queue.put(req)
+        self._tm.lmserver_queue_depth.set(self.queue_depth)
         if not req.done.wait(timeout):
             raise TimeoutError("decode did not complete in time")
         if req.error is not None:
             raise RuntimeError(req.error)
         return req.result
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests queued + held awaiting same-length company."""
+        return self._queue.qsize() + len(self._held)
 
     def close(self):
         self._stop.set()
@@ -166,7 +181,9 @@ class LMServer:
 
     def _run(self):
         while not self._stop.is_set():
-            batch = self._gather()
+            with span("lmserver.gather"):
+                batch = self._gather()
+            self._tm.lmserver_queue_depth.set(self.queue_depth)
             if not batch:
                 continue
             try:
@@ -180,6 +197,11 @@ class LMServer:
         import jax
 
         from bigdl_tpu.models.generation import generate
+        # anchor's wait from submit to dispatch == the batching latency a
+        # single-request client actually pays (bounded by batch_timeout)
+        self._tm.lmserver_batch_wait_seconds.observe(
+            _now() - batch[0].t_submit)
+        self._tm.lmserver_batch_size.observe(len(batch))
         s = len(batch[0].ids)
         # batch-bucket: pad with copies of row 0 to the next power of two —
         # dummy rows cost compute but keep the compile cache at
@@ -195,9 +217,13 @@ class LMServer:
         if self._base_key is None:
             self._base_key = jax.random.PRNGKey(self._seed)
         key = jax.random.fold_in(self._base_key, self._n_batches)
-        out = np.asarray(generate(self.model, prompt, self.max_new_tokens,
-                                  key=key, **self.sampling)).astype(int)
+        with span("lmserver.decode_batch", batch=len(batch), prompt_len=s):
+            out = np.asarray(generate(self.model, prompt,
+                                      self.max_new_tokens,
+                                      key=key, **self.sampling)).astype(int)
         self._n_batches += 1
+        self._tm.lmserver_batches_total.inc()
+        self._tm.lmserver_requests_total.inc(len(batch))
         eos = self.sampling["eos_id"]
         for i, req in enumerate(batch):
             cont = out[i, s:s + req.max_new].tolist()
@@ -220,27 +246,41 @@ def make_http_server(server: LMServer, host: str, port: int, tokenizer=None):
     POST /generate  {"prompt": [ids...]} | {"text": "..."} (needs tokenizer)
                     optional "max_new_tokens"
         -> {"ids": [...], "text": "..."?}
-    GET  /health    -> {"ok": true, "batches_served": N}
+    GET  /health    -> {"ok": true, "batches_served": N, "queue_depth": N}
+    GET  /metrics   -> Prometheus text exposition (the server's registry;
+                       docs/OBSERVABILITY.md has a scrape_config example)
     """
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from bigdl_tpu.telemetry import (PROMETHEUS_CONTENT_TYPE,
+                                     render_prometheus)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # quiet; the app logs itself
             pass
 
-        def _reply(self, code: int, payload: dict):
-            body = json.dumps(payload).encode()
+        def _send(self, code: int, body: bytes, content_type: str):
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
 
+        def _reply(self, code: int, payload: dict):
+            self._send(code, json.dumps(payload).encode(),
+                       "application/json")
+
         def do_GET(self):
+            if self.path == "/metrics":
+                reg = getattr(server, "registry", None)
+                return self._send(200, render_prometheus(reg).encode(),
+                                  PROMETHEUS_CONTENT_TYPE)
             if self.path != "/health":
-                return self._reply(404, {"error": "GET /health only"})
+                return self._reply(404,
+                                   {"error": "GET /health or /metrics"})
             self._reply(200, {"ok": True,
-                              "batches_served": server.batches_served})
+                              "batches_served": server.batches_served,
+                              "queue_depth": server.queue_depth})
 
         def do_POST(self):
             if self.path != "/generate":

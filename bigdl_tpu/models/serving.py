@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
@@ -45,6 +46,7 @@ import numpy as np
 
 from bigdl_tpu.nn.module import functional_apply
 from bigdl_tpu.models.generation import _decode_modules, sample_token
+from bigdl_tpu.telemetry import get_registry, instruments, span
 
 
 @dataclass
@@ -54,6 +56,7 @@ class _Request:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[List[int]] = None
     error: Optional[str] = None
+    t_submit: float = 0.0               # perf_counter at submit (TTFT/SLO)
 
 
 class _Slot:
@@ -72,9 +75,16 @@ class ContinuousLMServer:
                  decode_block: int = 8, max_new_tokens: int = 64,
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 0.0, greedy: bool = False,
-                 eos_id: Optional[int] = None, seed: int = 0):
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 registry=None):
         if slots < 1:
             raise ValueError("slots must be >= 1")
+        # telemetry (docs/OBSERVABILITY.md): TTFT / per-token latency /
+        # queue depth / slot occupancy — the serving SLO surface, exposed
+        # by make_http_server as GET /metrics
+        self.registry = registry if registry is not None else get_registry()
+        self._tm = instruments(self.registry)
+        self._tm.serving_slots_total.set(slots)
         mhas, pes, heads = _decode_modules(model)
         if pes:
             raise ValueError(
@@ -148,12 +158,19 @@ class ContinuousLMServer:
             raise ValueError(f"prompt {len(ids)} + max_new {max_new} "
                              f"exceeds the server max_len {self.max_len}")
         req = _Request(ids, max_new)
+        req.t_submit = time.perf_counter()
         self._queue.put(req)
+        self._tm.serving_queue_depth.set(self._queue.qsize())
         if not req.done.wait(timeout):
             raise TimeoutError("decode did not complete in time")
         if req.error is not None:
             raise RuntimeError(req.error)
         return req.result
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot (the /health SLO signal)."""
+        return self._queue.qsize()
 
     def close(self):
         self._stop.set()
@@ -209,6 +226,8 @@ class ContinuousLMServer:
 
             fn = jax.jit(run)
             self._prefill_fns[plen] = fn
+            # first-seen prompt length == a fresh XLA program at next call
+            self._tm.serving_recompiles_total.inc()
         return fn
 
     def _insert(self):
@@ -233,6 +252,7 @@ class ContinuousLMServer:
                 return jax.tree_util.tree_unflatten(treedef, out)
 
             self._insert_fn = jax.jit(run, donate_argnums=(0,))
+            self._tm.serving_recompiles_total.inc()
         return self._insert_fn
 
     def _step(self):
@@ -256,30 +276,40 @@ class ContinuousLMServer:
                 return out.T, bufs      # (slots, block)
 
             self._step_fn = jax.jit(run, donate_argnums=(1,))
+            self._tm.serving_recompiles_total.inc()
         return self._step_fn
 
     # --------------------------------------------------------------- worker
     def _admit(self, req: _Request) -> bool:
         plen = len(req.ids)
         try:
-            with self._single_mode():
-                prompt = jnp.asarray(np.asarray(req.ids, np.float32)[None])
-                lp, small = self._prefill(plen)(
-                    self.params, self._small_bufs0, prompt)
-            # key advances per ADMISSION (not per completion — several
-            # admits can happen between completions, and identical prompts
-            # sampled under a reused key would correlate perfectly)
-            self._n_admitted += 1
-            key = jax.random.fold_in(self._admit_key, self._n_admitted)
-            tok = int(sample_token(lp, key, **self.sampling)[0])
+            with span("serving.prefill", plen=plen):
+                with self._single_mode():
+                    prompt = jnp.asarray(
+                        np.asarray(req.ids, np.float32)[None])
+                    lp, small = self._prefill(plen)(
+                        self.params, self._small_bufs0, prompt)
+                # key advances per ADMISSION (not per completion — several
+                # admits can happen between completions, and identical
+                # prompts sampled under a reused key would correlate
+                # perfectly)
+                self._n_admitted += 1
+                key = jax.random.fold_in(self._admit_key, self._n_admitted)
+                tok = int(sample_token(lp, key, **self.sampling)[0])
             # peek, insert, THEN pop: an insert failure must not leak the
             # slot. (The insert donates self.buffers; a RUNTIME failure
             # mid-insert can still invalidate them — compile-time errors,
             # the common case, happen before donation.)
             slot = self._free[-1]
-            self.buffers = self._insert()(self.buffers, small,
-                                          jnp.int32(slot), jnp.int32(plen))
+            with span("serving.insert", slot=slot):
+                self.buffers = self._insert()(
+                    self.buffers, small, jnp.int32(slot), jnp.int32(plen))
             self._free.pop()
+            # first token sampled == time-to-first-token for this request
+            self._tm.serving_ttft_seconds.observe(
+                time.perf_counter() - req.t_submit)
+            self._tm.serving_admissions_total.inc()
+            self._tm.serving_tokens_total.inc()
             sl = _Slot(req)
             sl.emitted = [tok]
             sl.new_count = 1
@@ -287,10 +317,12 @@ class ContinuousLMServer:
             if self._finish_if_done(slot, sl):
                 return True
             self._active[slot] = sl
+            self._tm.serving_slots_occupied.set(len(self._active))
             return True
         except Exception as e:  # noqa: BLE001 — fail the one request
             req.error = f"{type(e).__name__}: {e}"
             req.done.set()
+            self._tm.serving_request_errors_total.inc()
             return False
 
     def _finish_if_done(self, slot: int, sl: _Slot) -> bool:
@@ -300,9 +332,13 @@ class ContinuousLMServer:
             sl.req.result = sl.emitted[:sl.req.max_new]
             sl.req.done.set()
             self._n_served += 1
+            self._tm.serving_requests_completed_total.inc()
+            self._tm.serving_request_latency_seconds.observe(
+                time.perf_counter() - sl.req.t_submit)
             if slot in self._active:
                 del self._active[slot]
             self._free.append(slot)
+            self._tm.serving_slots_occupied.set(len(self._active))
             return True
         return False
 
@@ -315,6 +351,10 @@ class ContinuousLMServer:
                 except queue.Empty:
                     break
                 self._admit(req)
+            # refresh AFTER the drain, every pass — a gauge written only
+            # on submit would stay stale (showing phantom backlog) once a
+            # failed admission or an idle loop empties the queue
+            self._tm.serving_queue_depth.set(self._queue.qsize())
             if not self._active:
                 try:
                     req = self._queue.get(timeout=0.05)
@@ -325,18 +365,47 @@ class ContinuousLMServer:
             # one decode block for every slot (dead rows compute garbage)
             self._steps += 1
             key = jax.random.fold_in(self._step_key, self._steps)
-            toks, self.buffers = self._step()(
-                self.params, self.buffers,
-                jnp.asarray(self._last_tok), key)
-            toks = np.asarray(toks)
+            try:
+                t_block = time.perf_counter()
+                with span("serving.decode_block", live=len(self._active)):
+                    toks, self.buffers = self._step()(
+                        self.params, self.buffers,
+                        jnp.asarray(self._last_tok), key)
+                    toks = np.asarray(toks)
+            except Exception as e:  # noqa: BLE001 — fail fast, keep serving
+                # a decode-step failure must not kill the worker silently:
+                # every in-flight request fails NOW (clients see the error
+                # instead of hanging to their timeout), the error counter
+                # records the incident, and the loop keeps admitting — if
+                # the donated buffers were invalidated mid-step, the next
+                # admission fails cleanly through _admit's handler too.
+                self._tm.serving_request_errors_total.inc(len(self._active))
+                for slot, sl in list(self._active.items()):
+                    sl.req.error = f"{type(e).__name__}: {e}"
+                    sl.req.done.set()
+                    self._free.append(slot)
+                self._active.clear()
+                self._tm.serving_slots_occupied.set(0)
+                continue
+            # per-token latency: block wall-clock (np.asarray is the host
+            # sync) amortized over the block — one observation per block
+            # keeps the hot loop at a few locked ops per decode_block
+            # tokens, not per token
+            self._tm.serving_token_latency_seconds.observe(
+                (time.perf_counter() - t_block) / self.decode_block)
+            self._tm.serving_decode_blocks_total.inc()
             self._last_tok = toks[:, -1].astype(np.int32)
             eos = self.eos_id
+            live_tokens = 0
             for slot, sl in list(self._active.items()):
                 for t in toks[slot]:
                     t = int(t)
                     sl.emitted.append(t)
                     sl.new_count += 1
+                    live_tokens += 1
                     if ((eos is not None and t == eos)
                             or sl.new_count >= sl.req.max_new):
                         break
                 self._finish_if_done(slot, sl)
+            if live_tokens:
+                self._tm.serving_tokens_total.inc(live_tokens)

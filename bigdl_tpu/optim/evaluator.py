@@ -20,6 +20,7 @@ from bigdl_tpu.dataset.base import (AbstractDataSet, LocalDataSet, MiniBatch,
                                     Sample, SampleToBatch)
 from bigdl_tpu.nn.module import Module, functional_apply
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.telemetry import get_registry, instruments, span
 
 
 def _as_minibatch(item) -> MiniBatch:
@@ -40,7 +41,19 @@ def evaluate_batches(fwd: Callable, params, buffers,
     static shape before ``fwd`` (XLA would otherwise compile a second
     program for the one odd shape) and the padded rows are sliced off the
     output before scoring — every record is evaluated, none double-counted.
+
+    Telemetry: ``bigdl_eval_batches_total`` / ``bigdl_eval_records_total``
+    counters + a per-batch host wall-clock histogram land in the global
+    registry; the whole call traces as one ``eval.batches`` span.
     """
+    with span("eval.batches", methods=len(v_methods)):
+        return _evaluate_batches(fwd, params, buffers, batches, v_methods,
+                                 cache)
+
+
+def _evaluate_batches(fwd, params, buffers, batches, v_methods, cache):
+    import time
+    tm = instruments(get_registry())
     results: List[Optional[ValidationResult]] = [None] * len(v_methods)
     count = 0
     full_bs: Optional[int] = None
@@ -88,7 +101,10 @@ def evaluate_batches(fwd: Callable, params, buffers,
 
         scorer = jax.jit(scorer_fn, donate_argnums=(4,))
     acc = None
+    n_batches = 0
     for item in batches:
+        t_batch = time.perf_counter()
+        n_batches += 1
         batch = _as_minibatch(item)
         n = batch.size()
         data = jnp.asarray(batch.data)
@@ -105,6 +121,7 @@ def evaluate_batches(fwd: Callable, params, buffers,
                        jnp.zeros((len(v_methods),), jnp.int32))
             acc = scorer(params, buffers, data, labels, acc)
             count += n
+            tm.eval_batch_seconds.observe(time.perf_counter() - t_batch)
             continue
         if n < full_bs and sliceable:
             pad = jnp.zeros((full_bs - n, *data.shape[1:]), data.dtype)
@@ -117,6 +134,9 @@ def evaluate_batches(fwd: Callable, params, buffers,
             r = m.apply(out, labels)
             results[i] = r if results[i] is None else results[i] + r
         count += n
+        tm.eval_batch_seconds.observe(time.perf_counter() - t_batch)
+    tm.eval_batches_total.inc(n_batches)
+    tm.eval_records_total.inc(count)
     if acc is not None:
         vals = np.asarray(acc[0])  # the ONE device->host sync
         counts = np.asarray(acc[1])

@@ -1,35 +1,71 @@
-"""Named performance counters (reference ``optim/Metrics.scala:31``).
+"""Named performance counters (reference ``optim/Metrics.scala:31``),
+bridged onto the telemetry registry.
 
 The reference backs these with Spark accumulators (driver-aggregated);
-here they are host-side counters the training loops feed with phase timings
-(data wait, step wall-clock, eval). ``summary()`` prints the same style of
-per-phase report the reference dumps at debug level
-(``DistriOptimizer.scala:283``).
+here each ``Metrics`` instance is a view over ``bigdl_tpu.telemetry``
+gauge children — ``bigdl_legacy_metric{scope=...,name=...}`` — so the
+training loop's counters land in the same ``GET /metrics`` scrape as the
+serving SLOs, with no second bookkeeping copy (the registry child IS the
+store; this class keeps only the ``parallel`` divisors and its name
+set). ``scope`` is a per-instance label: successive optimizer runs in
+one process stay distinguishable, fresh instances read zeros like they
+always did, and a finalizer removes the instance's children from the
+registry when it is collected — repeated Optimizer construction does not
+grow the scrape forever.
+
+``summary()`` prints the same per-phase report the reference dumps at
+debug level (``DistriOptimizer.scala:283``).
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
-from typing import Dict, Tuple
+import weakref
+from typing import Tuple
+
+from bigdl_tpu.telemetry import get_registry, instruments
+
+_scope_ids = itertools.count()
+
+
+def _drop_children(family, scope, names):
+    """weakref.finalize callback — must not close over the instance."""
+    for name in list(names):
+        family.remove(scope=scope, name=name)
 
 
 class Metrics:
-    def __init__(self):
+    def __init__(self, registry=None):
+        reg = registry if registry is not None else get_registry()
+        # the family comes from the catalogue (single source of truth for
+        # name/help/labels — docs/API.md renders the same spec)
+        self._family = instruments(reg).legacy_metric
+        self._scope = f"m{next(_scope_ids)}"
         self._lock = threading.Lock()
-        self._agg: Dict[str, Tuple[float, int]] = {}
+        self._parallel = {}     # name -> divisor (config, not a counter)
+        weakref.finalize(self, _drop_children, self._family, self._scope,
+                         self._parallel)
+
+    def _child(self, name: str):
+        return self._family.labels(scope=self._scope, name=name)
 
     def set(self, name: str, value: float, parallel: int = 1) -> None:
         with self._lock:
-            self._agg[name] = (value, parallel)
+            self._parallel[name] = parallel
+        self._child(name).set(value)
 
     def add(self, name: str, value: float) -> None:
         with self._lock:
-            v, n = self._agg.get(name, (0.0, 1))
-            self._agg[name] = (v + value, n)
+            self._parallel.setdefault(name, 1)
+        self._child(name).inc(value)
 
     def get(self, name: str) -> Tuple[float, int]:
         with self._lock:
-            return self._agg.get(name, (0.0, 1))
+            if name not in self._parallel:
+                return (0.0, 1)
+            n = self._parallel[name]
+        return (self._child(name).value, n)
 
     def value(self, name: str) -> float:
         v, n = self.get(name)
@@ -37,8 +73,12 @@ class Metrics:
 
     def summary(self, unit: str = "s", scale: float = 1.0) -> str:
         with self._lock:
-            lines = ["========== Metrics Summary =========="]
-            for name, (v, n) in sorted(self._agg.items()):
-                lines.append(f"{name} : {v / max(1, n) / scale} {unit}")
-            lines.append("=====================================")
-            return "\n".join(lines)
+            names = sorted(self._parallel)
+            divisors = dict(self._parallel)
+        lines = ["========== Metrics Summary =========="]
+        for name in names:
+            v = self._child(name).value
+            lines.append(f"{name} : {v / max(1, divisors[name]) / scale} "
+                         f"{unit}")
+        lines.append("=====================================")
+        return "\n".join(lines)

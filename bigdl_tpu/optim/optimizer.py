@@ -32,6 +32,7 @@ from bigdl_tpu.optim.methods import OptimMethod, SGD
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.triggers import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
+from bigdl_tpu.telemetry import get_registry, instruments, span
 from bigdl_tpu.utils import file_io
 from bigdl_tpu.utils.rng import RandomGenerator
 from bigdl_tpu.utils.table import Table, T
@@ -370,6 +371,37 @@ class Optimizer:
     def optimize(self) -> Module:
         raise NotImplementedError
 
+    def _telemetry_mode(self) -> str:
+        """Label value for the ``bigdl_train_*`` metric families
+        (docs/OBSERVABILITY.md); DistriOptimizer overrides with its mesh
+        sync mode so local and distributed step breakdowns stay separate
+        series in one scrape."""
+        return "local"
+
+    def _train_instruments(self):
+        """The mode-labeled training metric children (step-time breakdown,
+        throughput, compile counter) as a namespace; resolved once per
+        optimizer and cached (label resolution costs a schema check per
+        child — not something _validate should re-pay every trigger)."""
+        cached = getattr(self, "_tm_cache", None)
+        if cached is not None:
+            return cached
+        from types import SimpleNamespace
+        tm = instruments(get_registry())
+        mode = self._telemetry_mode()
+        cached = SimpleNamespace(
+            step=tm.train_step_seconds.labels(mode=mode),
+            data_wait=tm.train_data_wait_seconds.labels(mode=mode),
+            dispatch=tm.train_dispatch_seconds.labels(mode=mode),
+            sync=tm.train_sync_seconds.labels(mode=mode),
+            steps=tm.train_steps_total.labels(mode=mode),
+            records=tm.train_records_total.labels(mode=mode),
+            rps=tm.train_records_per_second.labels(mode=mode),
+            compiles=tm.train_compiles_total.labels(mode=mode),
+            validation=tm.train_validation_seconds.labels(mode=mode))
+        self._tm_cache = cached
+        return cached
+
     # ------------------------------------------------------------ checkpoint
     def _save_checkpoint(self, params, buffers, opt_state, driver_state) -> None:
         if self.checkpoint_path is None:
@@ -648,6 +680,7 @@ class LocalOptimizer(Optimizer):
         # its own iteration's true loss, one dispatch later.
         pending = None  # in-flight dispatch awaiting its loss fetch
         last_done = None  # wall time the previous dispatch's losses landed
+        tm = self._train_instruments()
 
         def flush():
             nonlocal pending, last_done
@@ -658,7 +691,10 @@ class LocalOptimizer(Optimizer):
             # sync point: blocks until the dispatch is done. A K-fused
             # dispatch (set_steps_per_dispatch) returns (K,) losses — one
             # exact log line per iteration either way.
-            losses = np.atleast_1d(np.asarray(p["losses"], np.float32))
+            t_sync = time.time()
+            with span("train.sync", k=len(p["iters"])):
+                losses = np.atleast_1d(np.asarray(p["losses"], np.float32))
+            tm.sync.observe(time.time() - t_sync)
             # inter-completion interval ~= per-dispatch device time in
             # steady state; measuring to the NEXT dispatch instead would
             # fold hook time and the next batch's data wait into
@@ -671,9 +707,14 @@ class LocalOptimizer(Optimizer):
             if p["iters"][0]["neval"] == 1:
                 # first step pays tracing+XLA compile (unless cached)
                 self.metrics.add("compile and first-step time", window_time)
+                tm.compiles.inc()
             for meta, loss_f in zip(p["iters"], losses):
                 loss_f = float(loss_f)
                 throughput = meta["n_records"] / max(iter_time, 1e-9)
+                tm.step.observe(iter_time)
+                tm.steps.inc()
+                tm.records.inc(meta["n_records"])
+                tm.rps.set(throughput)
                 driver_state["trainingLoss"] = loss_f
                 logger.info(
                     "[Epoch %d %d/%d][Iteration %d][Wall %.3fs] Trained %d "
@@ -753,7 +794,9 @@ class LocalOptimizer(Optimizer):
                         window.append(next(data_iter))
                     except StopIteration:
                         break
-                data_wait += time.time() - t_data
+                dw = time.time() - t_data
+                data_wait += dw
+                tm.data_wait.observe(dw)
                 k = len(window)
                 last_neval = neval0 + k - 1
                 if self._profile is not None:
@@ -763,31 +806,40 @@ class LocalOptimizer(Optimizer):
                         jax.profiler.start_trace(pdir)
                         self._profiling_active = True
                 t0 = time.time()
-                if k == 1:
-                    data, labels = self._place_batch(window[0])
-                    params, buffers, opt_state, losses = step(
-                        params, buffers, opt_state, rng.next_key(), data,
-                        labels)
-                else:
-                    from bigdl_tpu.dataset.device_cache import \
-                        CachedSliceBatch
-                    keys = jnp.stack([rng.next_key() for _ in window])
-                    if (all(isinstance(b, CachedSliceBatch) for b in window)
-                            and len({id(b.source) for b in window}) == 1):
-                        # gathers happen inside the fused program: ONE
-                        # dispatch per window
-                        src = window[0].source
-                        idx = jnp.stack([b.idx for b in window])
-                        params, buffers, opt_state, losses = \
-                            multi_step_cached(params, buffers, opt_state,
-                                              keys, src._x, src._y, idx)
+                with span("train.dispatch", k=k):
+                    if k == 1:
+                        data, labels = self._place_batch(window[0])
+                        params, buffers, opt_state, losses = step(
+                            params, buffers, opt_state, rng.next_key(),
+                            data, labels)
                     else:
-                        # host batches: one fused H2D + dispatch per window
-                        xs = jnp.stack([jnp.asarray(b.data) for b in window])
-                        ys = jnp.stack([jnp.asarray(b.labels)
-                                        for b in window])
-                        params, buffers, opt_state, losses = multi_step(
-                            params, buffers, opt_state, keys, xs, ys)
+                        from bigdl_tpu.dataset.device_cache import \
+                            CachedSliceBatch
+                        keys = jnp.stack([rng.next_key() for _ in window])
+                        if (all(isinstance(b, CachedSliceBatch)
+                                for b in window)
+                                and len({id(b.source)
+                                         for b in window}) == 1):
+                            # gathers happen inside the fused program: ONE
+                            # dispatch per window
+                            src = window[0].source
+                            idx = jnp.stack([b.idx for b in window])
+                            params, buffers, opt_state, losses = \
+                                multi_step_cached(params, buffers,
+                                                  opt_state, keys,
+                                                  src._x, src._y, idx)
+                        else:
+                            # host batches: one fused H2D + dispatch per
+                            # window
+                            xs = jnp.stack([jnp.asarray(b.data)
+                                            for b in window])
+                            ys = jnp.stack([jnp.asarray(b.labels)
+                                            for b in window])
+                            params, buffers, opt_state, losses = multi_step(
+                                params, buffers, opt_state, keys, xs, ys)
+                # host time enqueueing the window (async; device compute
+                # lands in the NEXT flush's sync wait)
+                tm.dispatch.observe(time.time() - t0)
                 flush()  # previous dispatch: fetch losses, log, summarize
                 # snapshot the lr as its own small array NOW: opt_state's
                 # buffers are donated to the next dispatch and deleted
@@ -883,9 +935,11 @@ class LocalOptimizer(Optimizer):
         if self.validation_dataset is None:
             return
         t0 = time.time()
-        results, count = self._run_validation(params, buffers, fwd)
+        with span("train.validate"):
+            results, count = self._run_validation(params, buffers, fwd)
         elapsed = time.time() - t0
         self.metrics.add("validation time", elapsed)
+        self._train_instruments().validation.observe(elapsed)
         logger.info("[Validation] %d records in %.3fs. Throughput is %.1f records/s",
                     count, elapsed, count / max(elapsed, 1e-9))
         for i, (m, r) in enumerate(zip(self.validation_methods, results)):

@@ -93,6 +93,12 @@ class DistriOptimizer(LocalOptimizer):
         if isinstance(dataset, DeviceCachedDataSet):
             dataset.set_mesh(self.mesh, DATA_AXIS)
 
+    def _telemetry_mode(self) -> str:
+        """Distributed step breakdowns scrape as their own series:
+        ``bigdl_train_*{mode="mesh-allreduce|sharded|fsdp"}`` next to the
+        local loop's ``mode="local"`` (docs/OBSERVABILITY.md)."""
+        return f"mesh-{self.sync_mode}"
+
     # ------------------------------------------------------------- placement
     def _place_batch(self, batch):
         """Commit one batch onto the mesh's data axis.

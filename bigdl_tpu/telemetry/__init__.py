@@ -1,0 +1,41 @@
+"""bigdl_tpu.telemetry — unified runtime observability.
+
+One subsystem answers the operator's first three questions (what is my
+TTFT, where does a training step spend its wall-clock, is the queue
+backing up) instead of per-module ad-hoc counters:
+
+- ``registry``: thread-safe labeled counters / gauges / fixed-bucket
+  histograms (``get_registry()`` is the process-global instance);
+- ``exposition``: Prometheus text 0.0.4 + JSON, served as ``GET
+  /metrics`` by the serving HTTP rim (``models/lm_server.py``);
+- ``tracing``: ``span("name")`` -> bounded ring buffer -> Chrome
+  ``trace_event`` JSON, disabled-by-default at one-branch cost;
+- ``catalogue``: the well-known metric/span inventory every instrumented
+  subsystem builds from (rendered into ``docs/API.md``).
+
+jax-free by design: importable from the bench orchestrator, the CLI
+(``python -m bigdl_tpu.telemetry``) and the launcher subcommands
+(``scripts/bigdl-tpu.sh metrics|trace``) without touching a backend.
+Guide: ``docs/OBSERVABILITY.md``.
+"""
+
+from bigdl_tpu.telemetry.registry import (Counter, CounterFamily, Gauge,
+                                          GaugeFamily, Histogram,
+                                          HistogramFamily, MetricSpec,
+                                          MetricsRegistry,
+                                          DEFAULT_LATENCY_BUCKETS,
+                                          get_registry, set_registry)
+from bigdl_tpu.telemetry.exposition import (PROMETHEUS_CONTENT_TYPE,
+                                            render_json, render_prometheus)
+from bigdl_tpu.telemetry import tracing
+from bigdl_tpu.telemetry.tracing import span
+from bigdl_tpu.telemetry.catalogue import (METRIC_SPECS, SPAN_SPECS,
+                                           instruments)
+
+__all__ = [
+    "MetricsRegistry", "MetricSpec", "Counter", "Gauge", "Histogram",
+    "CounterFamily", "GaugeFamily", "HistogramFamily",
+    "DEFAULT_LATENCY_BUCKETS", "get_registry", "set_registry",
+    "render_prometheus", "render_json", "PROMETHEUS_CONTENT_TYPE",
+    "tracing", "span", "METRIC_SPECS", "SPAN_SPECS", "instruments",
+]

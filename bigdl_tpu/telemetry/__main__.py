@@ -1,0 +1,198 @@
+"""Telemetry CLI: ``python -m bigdl_tpu.telemetry {metrics|trace} ...``
+(wrapped by ``scripts/bigdl-tpu.sh metrics|trace``).
+
+``metrics``  scrape a running server's ``/metrics`` (URL positional) and
+             print it; ``--selftest`` exercises the registry + exposition
+             pipeline in-process instead (CI smoke, no server needed).
+``trace``    validate a dumped Chrome trace_event JSON file and print a
+             per-span summary; ``--selftest`` records demo spans and
+             dumps a valid trace (to ``--out`` or stdout).
+
+Exit status: 0 ok, 1 invalid trace / failed scrape, 2 usage errors.
+jax-free: both subcommands run in milliseconds on a bare host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from bigdl_tpu.telemetry.exposition import render_json, render_prometheus
+from bigdl_tpu.telemetry.registry import MetricsRegistry
+from bigdl_tpu.telemetry import tracing
+from bigdl_tpu.telemetry.catalogue import instruments
+
+
+def _selftest_registry() -> MetricsRegistry:
+    """A private registry exercising every metric kind through the
+    catalogue specs (never the global one: a selftest must not pollute a
+    live process's scrape)."""
+    reg = MetricsRegistry()
+    ins = instruments(reg)
+    ins.serving_admissions_total.inc(3)
+    ins.serving_queue_depth.set(1)
+    ins.serving_slots_total.set(8)
+    ins.serving_slots_occupied.set(2)
+    for v in (0.004, 0.012, 0.03):
+        ins.serving_ttft_seconds.observe(v)
+    ins.train_steps_total.labels(mode="local").inc(5)
+    ins.train_step_seconds.labels(mode="local").observe(0.02)
+    return reg
+
+
+def cmd_metrics(args) -> int:
+    if args.selftest:
+        reg = _selftest_registry()
+        if args.format == "json":
+            print(render_json(reg, indent=2))
+        else:
+            sys.stdout.write(render_prometheus(reg))
+        return 0
+    if not args.url:
+        print("metrics: give a scrape URL (e.g. "
+              "http://127.0.0.1:8000/metrics) or --selftest", file=sys.stderr)
+        return 2
+    import urllib.request
+    url = args.url
+    if "://" not in url:
+        url = "http://" + url
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            body = resp.read().decode("utf-8", errors="replace")
+    except Exception as e:  # noqa: BLE001 — report, don't traceback
+        print(f"metrics: scrape of {url} failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    sys.stdout.write(body)
+    if body and not body.endswith("\n"):
+        sys.stdout.write("\n")
+    return 0
+
+
+def _validate_chrome_trace(obj) -> List[str]:
+    """Schema errors ([] == valid): the subset chrome://tracing/Perfetto
+    require of the JSON object form."""
+    errors = []
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        return ["top level must be an object with a 'traceEvents' array"]
+    evs = obj["traceEvents"]
+    if not isinstance(evs, list):
+        return ["'traceEvents' must be an array"]
+    for i, ev in enumerate(evs):
+        if not isinstance(ev, dict):
+            errors.append(f"event {i}: not an object")
+            continue
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            if key not in ev:
+                errors.append(f"event {i}: missing {key!r}")
+        if ev.get("ph") == "X" and "dur" not in ev:
+            errors.append(f"event {i}: complete event missing 'dur'")
+        if errors and len(errors) > 10:
+            errors.append("... (truncated)")
+            break
+    return errors
+
+
+def _trace_summary(evs: List[dict]) -> str:
+    by_name = {}
+    for ev in evs:
+        tot, n = by_name.get(ev.get("name", "?"), (0.0, 0))
+        by_name[ev.get("name", "?")] = (tot + float(ev.get("dur", 0.0)),
+                                        n + 1)
+    lines = [f"{len(evs)} events, {len(by_name)} span names"]
+    width = max((len(n) for n in by_name), default=4)
+    for name, (tot, n) in sorted(by_name.items(),
+                                 key=lambda kv: -kv[1][0]):
+        lines.append(f"  {name:<{width}}  n={n:<6} total={tot / 1e3:.3f}ms "
+                     f"mean={tot / n / 1e3:.3f}ms")
+    return "\n".join(lines)
+
+
+def cmd_trace(args) -> int:
+    if args.selftest:
+        was_enabled = tracing.is_enabled()
+        tracing.enable()
+        try:
+            with tracing.span("selftest.outer", kind="demo"):
+                for i in range(3):
+                    with tracing.span("selftest.inner", i=i):
+                        time.sleep(0.001)
+        finally:
+            if not was_enabled:
+                tracing.disable()
+        obj = tracing.to_chrome_trace()
+        errors = _validate_chrome_trace(obj)
+        if errors:
+            print("trace selftest produced an INVALID trace:",
+                  file=sys.stderr)
+            print("\n".join(errors), file=sys.stderr)
+            return 1
+        if args.out:
+            tracing.dump(args.out)
+            print(f"wrote {args.out}: {_trace_summary(obj['traceEvents'])}")
+        else:
+            print(json.dumps(obj))
+        return 0
+    if not args.file:
+        print("trace: give a dumped trace file to validate, or --selftest",
+              file=sys.stderr)
+        return 2
+    try:
+        with open(args.file) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"trace: cannot read {args.file}: {e}", file=sys.stderr)
+        return 1
+    errors = _validate_chrome_trace(obj)
+    if errors:
+        print(f"{args.file}: INVALID Chrome trace:", file=sys.stderr)
+        print("\n".join(errors), file=sys.stderr)
+        return 1
+    print(f"{args.file}: valid Chrome trace_event JSON")
+    print(_trace_summary(obj["traceEvents"]))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.telemetry",
+        description="metrics scrape + trace validation tools "
+                    "(docs/OBSERVABILITY.md)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    pm = sub.add_parser("metrics", help="scrape and print /metrics")
+    pm.add_argument("url", nargs="?", default="",
+                    help="server base URL or host:port (the /metrics path "
+                         "is appended if missing)")
+    pm.add_argument("--format", choices=("prometheus", "json"),
+                    default="prometheus",
+                    help="--selftest output format (scrapes print the "
+                         "server's body verbatim)")
+    pm.add_argument("--timeout", type=float, default=5.0)
+    pm.add_argument("--selftest", action="store_true",
+                    help="exercise registry+exposition in-process (CI "
+                         "smoke; no server)")
+    pm.set_defaults(fn=cmd_metrics)
+
+    pt = sub.add_parser("trace", help="validate/summarize a Chrome trace "
+                                      "dump")
+    pt.add_argument("file", nargs="?", default="",
+                    help="trace_event JSON file to validate")
+    pt.add_argument("--out", default="",
+                    help="--selftest: write the demo trace here instead "
+                         "of stdout")
+    pt.add_argument("--selftest", action="store_true",
+                    help="record demo spans and dump a valid trace")
+    pt.set_defaults(fn=cmd_trace)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,158 @@
+"""Well-known metric and span inventory — the single source of truth.
+
+Every instrumented subsystem (serving, training, eval, bench) creates its
+families FROM these specs, and ``scripts/gen_api_doc.py`` renders this
+table into ``docs/API.md`` — so the docs can never drift from what a
+scrape actually returns. Narrative guide: ``docs/OBSERVABILITY.md``.
+
+Bucket choices: serving latencies use the sub-ms-to-seconds default;
+training step phases reuse it (a CPU-fallback step is seconds, a TPU
+step sub-ms — the shared ladder covers both); batch sizes use power-of-
+two buckets matching the bucketed batcher's padding.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from bigdl_tpu.telemetry.registry import (DEFAULT_LATENCY_BUCKETS,
+                                          MetricSpec, MetricsRegistry)
+
+__all__ = ["METRIC_SPECS", "SPAN_SPECS", "instruments"]
+
+BATCH_SIZE_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128)
+
+METRIC_SPECS: List[MetricSpec] = [
+    # ---- continuous-batching serving engine (models/serving.py)
+    MetricSpec("bigdl_serving_ttft_seconds", "histogram",
+               "Time to first token: request submit to first sampled token "
+               "(prefill + queue wait).", (), DEFAULT_LATENCY_BUCKETS),
+    MetricSpec("bigdl_serving_token_latency_seconds", "histogram",
+               "Per-token decode latency, observed once per decode block "
+               "as block wall-clock / tokens.", (), DEFAULT_LATENCY_BUCKETS),
+    MetricSpec("bigdl_serving_request_latency_seconds", "histogram",
+               "Whole-request latency: submit to completion (one "
+               "observation per completed request).",
+               (), DEFAULT_LATENCY_BUCKETS),
+    MetricSpec("bigdl_serving_queue_depth", "gauge",
+               "Requests waiting for a slot (admission queue)."),
+    MetricSpec("bigdl_serving_slots_occupied", "gauge",
+               "Slots currently decoding a live request."),
+    MetricSpec("bigdl_serving_slots_total", "gauge",
+               "Configured slot count of the continuous server."),
+    MetricSpec("bigdl_serving_admissions_total", "counter",
+               "Requests admitted into a slot (prefill + insert done)."),
+    MetricSpec("bigdl_serving_requests_completed_total", "counter",
+               "Requests finished (eos or token budget)."),
+    MetricSpec("bigdl_serving_request_errors_total", "counter",
+               "Requests failed (admission or decode error)."),
+    MetricSpec("bigdl_serving_recompiles_total", "counter",
+               "New XLA program builds: first-seen prompt length prefill, "
+               "the step program, the insert program."),
+    MetricSpec("bigdl_serving_decode_blocks_total", "counter",
+               "Jitted decode blocks dispatched over all slots."),
+    MetricSpec("bigdl_serving_tokens_total", "counter",
+               "Tokens emitted to live requests (dead-slot lanes "
+               "excluded)."),
+    # ---- bucketed batch server (models/lm_server.py)
+    MetricSpec("bigdl_lmserver_batch_size", "histogram",
+               "Requests per dispatched batch (pre-padding).",
+               (), BATCH_SIZE_BUCKETS),
+    MetricSpec("bigdl_lmserver_batch_wait_seconds", "histogram",
+               "Anchor request's wait from submit to batch dispatch.",
+               (), DEFAULT_LATENCY_BUCKETS),
+    MetricSpec("bigdl_lmserver_batches_total", "counter",
+               "Batches decoded by the bucketed server."),
+    MetricSpec("bigdl_lmserver_requests_total", "counter",
+               "Requests served by the bucketed server."),
+    MetricSpec("bigdl_lmserver_queue_depth", "gauge",
+               "Requests queued or held awaiting same-length company."),
+    # ---- training loops (optim/optimizer.py, parallel/distri_optimizer.py)
+    MetricSpec("bigdl_train_step_seconds", "histogram",
+               "Per-iteration device step time (window wall-clock / "
+               "iterations in the dispatch window).",
+               ("mode",), DEFAULT_LATENCY_BUCKETS),
+    MetricSpec("bigdl_train_data_wait_seconds", "histogram",
+               "Host wait on the data pipeline per dispatch window.",
+               ("mode",), DEFAULT_LATENCY_BUCKETS),
+    MetricSpec("bigdl_train_dispatch_seconds", "histogram",
+               "Host time handing a window to the device (H2D + enqueue; "
+               "async — excludes device compute).",
+               ("mode",), DEFAULT_LATENCY_BUCKETS),
+    MetricSpec("bigdl_train_sync_seconds", "histogram",
+               "Host block fetching the pipelined losses (device->host "
+               "sync point).", ("mode",), DEFAULT_LATENCY_BUCKETS),
+    MetricSpec("bigdl_train_steps_total", "counter",
+               "Optimizer iterations completed.", ("mode",)),
+    MetricSpec("bigdl_train_records_total", "counter",
+               "Training records consumed.", ("mode",)),
+    MetricSpec("bigdl_train_records_per_second", "gauge",
+               "Most recent per-iteration throughput (records or tokens "
+               "per second).", ("mode",)),
+    MetricSpec("bigdl_train_compiles_total", "counter",
+               "Trace+compile events charged to the loop (first dispatch "
+               "of a step program).", ("mode",)),
+    MetricSpec("bigdl_train_validation_seconds", "histogram",
+               "Wall-clock of in-training validation passes.",
+               ("mode",), DEFAULT_LATENCY_BUCKETS),
+    # ---- batch evaluation (optim/evaluator.py)
+    MetricSpec("bigdl_eval_batches_total", "counter",
+               "Evaluation batches scored."),
+    MetricSpec("bigdl_eval_records_total", "counter",
+               "Evaluation records scored."),
+    MetricSpec("bigdl_eval_batch_seconds", "histogram",
+               "Host wall-clock per evaluation batch (async dispatch in "
+               "the device-accumulation steady state).",
+               (), DEFAULT_LATENCY_BUCKETS),
+    # ---- legacy bridge (optim/metrics.py)
+    MetricSpec("bigdl_legacy_metric", "gauge",
+               "Legacy optim.Metrics counters bridged onto the registry "
+               "(scope = one Metrics instance, name = reference counter "
+               "name).", ("scope", "name")),
+    # ---- bench harness (bench.py)
+    MetricSpec("bigdl_bench_step_seconds", "histogram",
+               "Benchmark timed-loop per-step wall-clock (chunk time / "
+               "steps; embedded in BENCH_*.json).",
+               (), DEFAULT_LATENCY_BUCKETS + (60.0, 120.0)),
+]
+
+#: Span inventory (tracing.span names) with where they fire.
+SPAN_SPECS: List[Tuple[str, str]] = [
+    ("serving.prefill", "Out-of-band b=1 prompt prefill + admission "
+     "sampling (models/serving.py _admit)."),
+    ("serving.insert", "Jitted cache scatter of a prefilled request into "
+     "a free slot row."),
+    ("serving.decode_block", "One jitted decode_block-token step over all "
+     "slots."),
+    ("lmserver.gather", "Batcher wait assembling one same-length batch."),
+    ("lmserver.decode_batch", "One batched prefill+decode program "
+     "(models/lm_server.py)."),
+    ("train.dispatch", "Handing one training window to the device (H2D + "
+     "enqueue)."),
+    ("train.sync", "Blocking fetch of the pipelined window losses."),
+    ("train.validate", "In-training validation pass."),
+    ("eval.batches", "One evaluate_batches call (all batches + the final "
+     "device->host merge)."),
+]
+
+
+class _Instruments:
+    """Attribute-addressed families for one registry: ``ins.<name>`` with
+    the ``bigdl_`` prefix stripped. Built once per (registry) and cached
+    on the registry object — instrument sites pay one dict lookup."""
+
+    def __init__(self, registry: MetricsRegistry):
+        for spec in METRIC_SPECS:
+            fam = registry.from_spec(spec)
+            if not spec.labels:
+                fam.labels()  # expose at 0 before first use (scrape-friendly)
+            setattr(self, spec.name[len("bigdl_"):], fam)
+
+
+def instruments(registry: MetricsRegistry) -> _Instruments:
+    """Get-or-build the catalogue's families on ``registry``."""
+    ins = getattr(registry, "_bigdl_instruments", None)
+    if ins is None:
+        ins = _Instruments(registry)
+        registry._bigdl_instruments = ins
+    return ins

@@ -5,6 +5,8 @@
 #   ./scripts/bigdl-tpu.sh -- python -m bigdl_tpu.apps.lenet train -b 256
 #   ./scripts/bigdl-tpu.sh -- bigdl-tpu-perf --model resnet50
 #   ./scripts/bigdl-tpu.sh lint [paths... --select/--ignore/--format ...]
+#   ./scripts/bigdl-tpu.sh metrics [url|--selftest]   # scrape /metrics
+#   ./scripts/bigdl-tpu.sh trace [file|--selftest]    # Chrome trace tools
 set -euo pipefail
 
 # --- lint subcommand: graftlint, the AST-based JAX-hazard linter
@@ -17,6 +19,18 @@ if [[ "${1:-}" == "lint" ]]; then
   root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
   export PYTHONPATH="$root${PYTHONPATH:+:$PYTHONPATH}"
   exec python -m bigdl_tpu.analysis "$@"
+fi
+
+# --- telemetry subcommands (docs/OBSERVABILITY.md): scrape a serving
+#     process's /metrics, or validate/produce Chrome trace dumps. Both are
+#     jax-free (they run in milliseconds on a bare host).
+#       ./scripts/bigdl-tpu.sh metrics localhost:8000
+#       ./scripts/bigdl-tpu.sh trace /tmp/bigdl_trace.json
+if [[ "${1:-}" == "metrics" || "${1:-}" == "trace" ]]; then
+  sub="$1"; shift
+  root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+  export PYTHONPATH="$root${PYTHONPATH:+:$PYTHONPATH}"
+  exec python -m bigdl_tpu.telemetry "$sub" "$@"
 fi
 
 # --- compilation cache: first compile of a big model is 20-40s; persist it

@@ -68,3 +68,38 @@ def test_launcher_execs_command(tmp_path):
         capture_output=True, timeout=120, env=env)
     assert r.returncode == 0, r.stderr.decode(errors="replace")
     assert b"4 cpu" in r.stdout
+
+
+def test_launcher_metrics_and_trace_subcommands(tmp_path):
+    """Telemetry subcommands (docs/OBSERVABILITY.md): both are jax-free
+    and must produce their artifact — Prometheus text on stdout, a valid
+    Chrome trace_event JSON on disk — in seconds."""
+    import json
+
+    launcher = os.path.join(REPO, "scripts", "bigdl-tpu.sh")
+    r = subprocess.run([launcher, "metrics", "--selftest"],
+                       capture_output=True, timeout=60)
+    assert r.returncode == 0, r.stderr.decode(errors="replace")
+    out = r.stdout.decode()
+    assert "# TYPE bigdl_serving_ttft_seconds histogram" in out
+    assert "bigdl_serving_admissions_total 3" in out
+
+    trace_file = str(tmp_path / "trace.json")
+    r = subprocess.run([launcher, "trace", "--selftest", "--out",
+                        trace_file], capture_output=True, timeout=60)
+    assert r.returncode == 0, r.stderr.decode(errors="replace")
+    obj = json.load(open(trace_file))
+    assert obj["traceEvents"] and obj["traceEvents"][0]["ph"] == "X"
+
+    # validator mode accepts its own dump
+    r = subprocess.run([launcher, "trace", trace_file],
+                       capture_output=True, timeout=60)
+    assert r.returncode == 0, r.stderr.decode(errors="replace")
+    assert b"valid Chrome trace_event JSON" in r.stdout
+
+    # and rejects garbage with exit 1
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"notTraceEvents": []}')
+    r = subprocess.run([launcher, "trace", str(bad)],
+                       capture_output=True, timeout=60)
+    assert r.returncode == 1

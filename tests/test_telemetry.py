@@ -1,0 +1,452 @@
+"""Telemetry subsystem (``bigdl_tpu/telemetry``): registry semantics,
+exposition formats, tracer ring buffer, the legacy ``Metrics`` bridge,
+live-server scrape (``GET /metrics``), submit-vs-scrape concurrency, and
+the disabled-path overhead budget.
+
+Budget: the whole module must stay well under 15s — every serving test
+shares ONE module-scoped ContinuousLMServer (one prefill/insert/step
+compile) and all prompts share one length (no extra prefill programs).
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.telemetry import (MetricsRegistry, get_registry, instruments,
+                                 render_json, render_prometheus, span,
+                                 tracing)
+
+VOCAB = 24
+
+
+# --------------------------------------------------------------- registry
+class TestRegistry:
+    def test_counter_monotonic_and_negative_rejected(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_total", "help")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_set_inc_dec(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("t_depth", "help")
+        g.set(5)
+        g.inc(2)
+        g.dec()
+        assert g.value == 6.0
+
+    def test_histogram_buckets_cumulative(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t_lat", "help", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.01, 0.05, 0.5, 5.0):
+            h.observe(v)
+        snap = h.labels().snapshot()
+        # le=0.01 holds 0.005 AND the boundary value 0.01
+        assert dict((b, c) for b, c in snap["buckets"]) == \
+            {0.01: 2, 0.1: 3, 1.0: 4}
+        assert snap["inf"] == 5 == snap["count"]
+        assert snap["sum"] == pytest.approx(5.565)
+
+    def test_histogram_summary_quantiles(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t_q", "help", buckets=(1, 2, 4, 8))
+        for v in [0.5] * 50 + [3.0] * 45 + [7.0] * 5:
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 100
+        assert s["p50"] == 1 and s["p90"] == 4 and s["p99"] == 8
+
+    def test_labels_children_independent(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("t_steps", "help", labels=("mode",))
+        fam.labels(mode="local").inc(3)
+        fam.labels(mode="mesh").inc(1)
+        assert fam.labels(mode="local").value == 3.0
+        assert fam.labels(mode="mesh").value == 1.0
+        with pytest.raises(ValueError):
+            fam.labels(wrong="x")
+        with pytest.raises(ValueError):
+            fam.inc()  # labeled family has no solo child
+
+    def test_reregistration_idempotent_conflict_raises(self):
+        reg = MetricsRegistry()
+        a = reg.counter("t_c", "help")
+        assert reg.counter("t_c", "other help") is a
+        with pytest.raises(ValueError):
+            reg.gauge("t_c", "kind conflict")
+        reg.histogram("t_h", "help", buckets=(1, 2))
+        with pytest.raises(ValueError):
+            reg.histogram("t_h", "help", buckets=(1, 2, 3))
+
+    def test_invalid_names_rejected(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("bad name", "help")
+        with pytest.raises(ValueError):
+            reg.counter("ok_name", "help", labels=("bad-label",))
+
+
+# ------------------------------------------------------------- exposition
+class TestExposition:
+    def _demo(self):
+        reg = MetricsRegistry()
+        reg.counter("d_total", "a counter").inc(7)
+        fam = reg.gauge("d_depth", "a gauge", labels=("q",))
+        fam.labels(q='we"ird\n\\').set(2)
+        h = reg.histogram("d_lat", "a histogram", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        return reg
+
+    def test_prometheus_text(self):
+        text = render_prometheus(self._demo())
+        assert "# TYPE d_total counter\nd_total 7\n" in text
+        assert "# TYPE d_lat histogram" in text
+        assert 'd_lat_bucket{le="0.1"} 1' in text
+        assert 'd_lat_bucket{le="1"} 2' in text
+        assert 'd_lat_bucket{le="+Inf"} 2' in text
+        assert "d_lat_sum 0.55" in text
+        assert "d_lat_count 2" in text
+        # label values escape quotes, newlines, backslashes
+        assert r'd_depth{q="we\"ird\n\\"} 2' in text
+
+    def test_json_roundtrip(self):
+        obj = json.loads(render_json(self._demo()))
+        by_name = {m["name"]: m for m in obj["metrics"]}
+        assert by_name["d_total"]["samples"][0]["value"] == 7.0
+        hist = by_name["d_lat"]["samples"][0]["histogram"]
+        assert hist["count"] == 2 and hist["inf"] == 2
+
+
+# ---------------------------------------------------------------- tracing
+@pytest.fixture()
+def clean_tracer():
+    tracing.disable()
+    tracing.clear()
+    yield
+    tracing.disable()
+    tracing.clear()
+    tracing.set_capacity(tracing.DEFAULT_CAPACITY)
+
+
+class TestTracing:
+    def test_disabled_is_shared_noop(self, clean_tracer):
+        a, b = span("x"), span("y")
+        assert a is b  # one stateless instance: zero allocation when off
+        with a:
+            a.annotate(k=1)
+        assert tracing.events() == []
+
+    def test_enabled_records_complete_events(self, clean_tracer):
+        tracing.enable()
+        with span("outer", cat="test", foo=1) as s:
+            s.annotate(bar=2)
+            with span("inner"):
+                pass
+        evs = tracing.events()
+        assert [e["name"] for e in evs] == ["inner", "outer"]
+        outer = evs[1]
+        assert outer["ph"] == "X" and outer["dur"] >= 0
+        assert {"name", "cat", "ph", "ts", "dur", "pid", "tid"} <= set(outer)
+        assert outer["args"] == {"foo": 1, "bar": 2}
+
+    def test_ring_buffer_bounded_keeps_newest(self, clean_tracer):
+        tracing.enable(capacity=16)
+        for i in range(100):
+            with span(f"s{i}"):
+                pass
+        evs = tracing.events()
+        assert len(evs) == 16
+        assert evs[-1]["name"] == "s99" and evs[0]["name"] == "s84"
+
+    def test_chrome_trace_dump_is_valid(self, clean_tracer, tmp_path):
+        tracing.enable()
+        with span("a"):
+            pass
+        path = tracing.dump(str(tmp_path / "trace.json"))
+        obj = json.load(open(path))
+        assert isinstance(obj["traceEvents"], list) and obj["traceEvents"]
+        ev = obj["traceEvents"][0]
+        assert ev["ph"] == "X"
+        for key in ("name", "ts", "dur", "pid", "tid"):
+            assert key in ev
+
+    def test_error_spans_are_tagged(self, clean_tracer):
+        tracing.enable()
+        with pytest.raises(RuntimeError):
+            with span("boom"):
+                raise RuntimeError("x")
+        assert tracing.events()[-1]["args"]["error"] == "RuntimeError"
+
+
+# ----------------------------------------------------------- legacy bridge
+class TestLegacyMetricsBridge:
+    def test_counters_surface_in_exposition(self):
+        from bigdl_tpu.optim.metrics import Metrics
+        reg = MetricsRegistry()
+        m = Metrics(registry=reg)
+        m.set("computing time average", 0.0, parallel=4)
+        m.add("computing time average", 8.0)
+        m.add("data wait time", 1.5)
+        assert m.get("computing time average") == (8.0, 4)
+        assert m.value("computing time average") == 2.0
+        text = render_prometheus(reg)
+        assert re.search(
+            r'bigdl_legacy_metric\{scope="m\d+",name="data wait time"\} 1\.5',
+            text)
+        s = m.summary()
+        assert s.startswith("========== Metrics Summary ==========")
+        assert "computing time average : 2.0 s" in s
+
+    def test_instances_are_isolated(self):
+        from bigdl_tpu.optim.metrics import Metrics
+        reg = MetricsRegistry()
+        a, b = Metrics(registry=reg), Metrics(registry=reg)
+        a.add("x", 5.0)
+        b.add("x", 1.0)
+        assert a.get("x") == (5.0, 1) and b.get("x") == (1.0, 1)
+        assert "x" not in Metrics(registry=reg).summary()
+
+    def test_scope_children_removed_on_gc(self):
+        """A collected Metrics instance must not leave its series in the
+        scrape forever (repeated Optimizer construction would otherwise
+        grow the registry unboundedly)."""
+        import gc
+        from bigdl_tpu.optim.metrics import Metrics
+        reg = MetricsRegistry()
+        m = Metrics(registry=reg)
+        m.add("x", 1.0)
+        scope = m._scope
+        assert f'scope="{scope}"' in render_prometheus(reg)
+        del m
+        gc.collect()
+        assert f'scope="{scope}"' not in render_prometheus(reg)
+
+
+# ------------------------------------------------- live server + scraping
+def _mk_model():
+    from bigdl_tpu.models import transformer
+    from bigdl_tpu.utils.rng import manual_seed
+    manual_seed(11)
+    return transformer.build_lm(VOCAB, 16, 2, 32, num_layers=1, max_len=32,
+                                rope=True, norm="rms")
+
+
+@pytest.fixture(scope="module")
+def continuous_server():
+    from bigdl_tpu.models.serving import ContinuousLMServer
+    srv = ContinuousLMServer(_mk_model(), slots=2, max_len=32, greedy=True,
+                             decode_block=2, max_new_tokens=8)
+    yield srv
+    srv.close()
+
+
+def _prom_value(text: str, name: str) -> float:
+    m = re.search(rf"^{re.escape(name)} ([0-9.e+-]+)$", text, re.M)
+    assert m, f"{name} not found in exposition"
+    return float(m.group(1))
+
+
+class TestLiveScrape:
+    def test_http_metrics_and_health(self, continuous_server):
+        from bigdl_tpu.models.lm_server import make_http_server
+        continuous_server.submit([3, 7, 2], max_new_tokens=4, timeout=60)
+        httpd = make_http_server(continuous_server, "127.0.0.1", 0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            port = httpd.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/plain")
+                body = r.read().decode()
+            # the serving SLO surface (acceptance criteria): TTFT
+            # histogram, queue depth, slot occupancy
+            assert re.search(
+                r'bigdl_serving_ttft_seconds_bucket\{le="\+Inf"\} \d+',
+                body)
+            assert _prom_value(body, "bigdl_serving_ttft_seconds_count") >= 1
+            assert "bigdl_serving_queue_depth" in body
+            assert "bigdl_serving_slots_occupied" in body
+            assert _prom_value(body, "bigdl_serving_slots_total") == 2
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["ok"] is True and "queue_depth" in health
+        finally:
+            httpd.shutdown()
+
+    def test_lm_server_http_metrics(self):
+        from bigdl_tpu.models import transformer
+        from bigdl_tpu.models.lm_server import LMServer, make_http_server
+        from bigdl_tpu.utils.rng import manual_seed
+        manual_seed(5)
+        lm = transformer.build_lm(VOCAB, 16, 2, 32, num_layers=1, max_len=32)
+        srv = LMServer(lm, greedy=True, max_new_tokens=4)
+        httpd = make_http_server(srv, "127.0.0.1", 0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            srv.submit([3, 5, 7], timeout=60)
+            port = httpd.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                body = r.read().decode()
+            assert _prom_value(body, "bigdl_lmserver_batches_total") >= 1
+            assert _prom_value(body, "bigdl_lmserver_requests_total") >= 1
+            assert "bigdl_lmserver_batch_wait_seconds_count" in body
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["queue_depth"] == 0
+        finally:
+            httpd.shutdown()
+            srv.close()
+
+
+class TestConcurrentSubmitAndScrape:
+    def test_counters_monotonic_histograms_exact(self, continuous_server):
+        """N submitter threads + a scraper thread: counters never step
+        back, nothing raises, and after the join the completed-request
+        counter and latency-histogram deltas equal the submitted total."""
+        tm = instruments(get_registry())
+        done0 = tm.serving_requests_completed_total.value
+        hist0 = tm.serving_request_latency_seconds.labels().snapshot()
+        ttft0 = tm.serving_ttft_seconds.labels().snapshot()
+
+        n_threads, per_thread = 3, 2
+        errors = []
+        seen = []
+        stop = threading.Event()
+
+        def submitter(i):
+            try:
+                for j in range(per_thread):
+                    out = continuous_server.submit([5, 9, 1 + i],
+                                                   max_new_tokens=3,
+                                                   timeout=60)
+                    assert len(out) <= 3
+            except Exception as e:  # noqa: BLE001 — fail the test, not CI
+                errors.append(e)
+
+        def scraper():
+            try:
+                while not stop.is_set():
+                    text = render_prometheus()
+                    seen.append(_prom_value(
+                        text, "bigdl_serving_requests_completed_total"))
+                    time.sleep(0.002)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=submitter, args=(i,))
+                   for i in range(n_threads)]
+        scr = threading.Thread(target=scraper)
+        scr.start()
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        stop.set()
+        scr.join()
+        assert not errors, errors
+        assert seen == sorted(seen), "completed counter went backwards"
+        total = n_threads * per_thread
+        assert tm.serving_requests_completed_total.value - done0 == total
+        hist1 = tm.serving_request_latency_seconds.labels().snapshot()
+        assert hist1["count"] - hist0["count"] == total
+        ttft1 = tm.serving_ttft_seconds.labels().snapshot()
+        assert ttft1["count"] - ttft0["count"] == total
+
+
+# ------------------------------------------------------- overhead budget
+class TestDisabledOverhead:
+    def _per_op(self, fn, n=20000):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter() - t0) / n
+
+    def test_instrumentation_within_2pct_of_step_time(self,
+                                                      continuous_server,
+                                                      clean_tracer):
+        """The acceptance bound, asserted as a per-op budget (robust to
+        CI noise where a wall-clock A/B of two step loops is not): the
+        instrumented decode-block and optimizer-step paths execute <= ~12
+        telemetry ops; 12x the measured per-op cost must stay under 2% of
+        the measured per-step device time."""
+        reg = MetricsRegistry()
+        c = reg.counter("ovh_total", "x")
+        h = reg.histogram("ovh_lat", "x")
+        g = reg.gauge("ovh_depth", "x")
+
+        def disabled_span():
+            with span("ovh"):
+                pass
+
+        t_span = self._per_op(disabled_span)
+        t_inc = self._per_op(c.inc)
+        t_obs = self._per_op(lambda: h.observe(0.01))
+        t_set = self._per_op(lambda: g.set(1))
+        # a superset of both hot paths' actual op mixes (decode block:
+        # 1 span + 1 observe + 2 inc + 1 set; optimizer iteration:
+        # 2 spans + 4 observes + 2 inc + 1 set)
+        overhead_per_step = 2 * t_span + 4 * t_obs + 3 * t_inc + 2 * t_set
+
+        # real decode-block time from the instrumented serving engine
+        tm = instruments(get_registry())
+        before = tm.serving_token_latency_seconds.labels().snapshot()
+        continuous_server.submit([2, 4, 6], max_new_tokens=6, timeout=60)
+        after = tm.serving_token_latency_seconds.labels().snapshot()
+        n_new = after["count"] - before["count"]
+        assert n_new > 0
+        block_s = ((after["sum"] - before["sum"]) / n_new
+                   * continuous_server.decode_block)
+        assert overhead_per_step < 0.02 * block_s, \
+            (overhead_per_step, block_s)
+
+        # real optimizer-step time: a jitted training step big enough to
+        # sit in the ms range (a sub-100µs toy step would make the 2%
+        # bound noise-dominated, not telemetry-dominated)
+        import jax
+        import jax.numpy as jnp
+        from bigdl_tpu import nn
+        from bigdl_tpu.nn.module import functional_apply
+        from bigdl_tpu.optim.methods import SGD
+        model = (nn.Sequential().add(nn.Linear(256, 256)).add(nn.ReLU())
+                 .add(nn.Linear(256, 10)).add(nn.LogSoftMax()))
+        crit = nn.ClassNLLCriterion()
+        params = model.parameter_tree()
+        buffers = model.buffer_tree()
+        opt = SGD(learningrate=0.1)
+        opt_state = opt.init_state(params)
+        data = jnp.asarray(np.random.RandomState(0)
+                           .randn(128, 256).astype(np.float32))
+        labels = jnp.asarray(np.ones((128,), np.float32))
+
+        @jax.jit
+        def step(p, b, o):
+            def loss_fn(p):
+                out, nb = functional_apply(model, p, b, data, training=True)
+                return crit.apply(out, labels), nb
+            grads, _ = jax.grad(loss_fn, has_aux=True)(p)
+            np_, no = opt.update(grads, o, p)
+            return np_, no
+
+        params, opt_state = step(params, buffers, opt_state)  # compile
+        jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+        t0 = time.perf_counter()
+        reps = 20
+        for _ in range(reps):
+            params, opt_state = step(params, buffers, opt_state)
+        jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+        opt_step_s = (time.perf_counter() - t0) / reps
+        assert overhead_per_step < 0.02 * opt_step_s, \
+            (overhead_per_step, opt_step_s)
