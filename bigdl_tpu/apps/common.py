@@ -46,6 +46,16 @@ def train_parser(prog: str, default_batch: int = 128,
                    default=None, metavar=("MIN", "MAX"),
                    help="clamp every gradient element into [MIN, MAX] "
                    "(reference setConstantGradientClipping)")
+    p.add_argument("--autoResume", action="store_true",
+                   help="continue from the newest COMPLETE snapshot under "
+                   "--checkpoint (partial writes rejected; "
+                   "docs/RESILIENCE.md) — the relaunch half of preemption "
+                   "survival")
+    p.add_argument("--preemptSnapshot", action="store_true",
+                   help="install SIGTERM hooks: a preemption notice "
+                   "triggers one final end-of-step snapshot + RESUME "
+                   "marker under --checkpoint, then exits "
+                   "(bigdl_tpu.resilience)")
     return p
 
 
@@ -87,6 +97,10 @@ def build_optimizer(model, train_set, criterion, args,
         opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
         if args.overWriteCheckpoint:
             opt.overwrite_checkpoint()
+    if getattr(args, "autoResume", False):
+        opt.auto_resume()
+    if getattr(args, "preemptSnapshot", False):
+        opt.set_preemption_handler()
     if validation_set is not None:
         opt.set_validation(Trigger.every_epoch(), validation_set,
                            methods or [Top1Accuracy(), Top5Accuracy(), Loss()])

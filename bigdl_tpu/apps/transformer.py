@@ -146,9 +146,8 @@ def train(argv):
         criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
 
     if args.contextParallel:
-        if args.model or args.state:
-            raise SystemExit("--model/--state resume is not supported with "
-                             "--contextParallel yet")
+        if bool(args.model) != bool(args.state):
+            raise SystemExit("--model and --state must be passed together")
         trained = _train_context_parallel(model, criterion, ds, args)
     elif args.tensorParallel > 1:
         # dp x tp mesh through the standard Optimizer path: Megatron specs
@@ -186,8 +185,13 @@ def _train_context_parallel(model, criterion, ds, args):
       axis bound, with the per-shard loss ``pmean``-ed (without it the
       shard_map transpose psums gradients P times too large).
 
-    Cadence checkpoints/TensorBoard summaries are not wired in this mode
-    (warned below); the final model is still saved by the caller.
+    Checkpoint/resume rides the resilience coordinator
+    (``bigdl_tpu/resilience``): ``--checkpoint`` writes per-epoch
+    (model.N, state.N) pairs + RESUME markers, ``--model/--state`` (or
+    ``--autoResume``) restores params/optimizer/epoch counters — from a
+    cp-format pair, OR from a full-model snapshot written by the standard
+    Optimizer loop (plain or sharded; the param tree is re-split into the
+    embed/tail halves). TensorBoard summaries remain unwired here.
     """
     import logging
 
@@ -233,6 +237,69 @@ def _train_context_parallel(model, criterion, ds, args):
     params = {"embed": embed.parameter_tree(), "tail": tail.parameter_tree()}
     opt_state = method.init_state(params)
 
+    from bigdl_tpu.resilience import coordinator
+    start_epoch, neval = 1, 1
+    resume_model, resume_state = args.model, args.state
+    if (not resume_model and getattr(args, "autoResume", False)
+            and args.checkpoint):
+        point = coordinator.latest_resume_point(args.checkpoint)
+        if point is not None:
+            resume_model, resume_state = point.model_path, point.state_path
+            log.info("[AutoResume] discovered snapshot %s", resume_model)
+    if resume_model and resume_state:
+        state_tpl = jax.eval_shape(method.init_state, params)
+        try:  # cp-format pair first ({"embed","tail"} param halves)
+            saved_params, saved_state, driver = coordinator \
+                .load_snapshot_host(resume_model, resume_state, params,
+                                    state_tpl)
+        except KeyError:  # a standard-loop snapshot: full model tree
+            full_tpl = model.parameter_tree()
+            full_state_tpl = jax.eval_shape(method.init_state, full_tpl)
+            saved_params, saved_state, driver = coordinator \
+                .load_snapshot_host(resume_model, resume_state, full_tpl,
+                                    full_state_tpl)
+        if isinstance(saved_params, dict) \
+                and set(saved_params) == {"embed", "tail"}:
+            params = jax.tree_util.tree_map(jnp.asarray, saved_params)
+        else:  # full-model tree -> load, then re-split into the halves
+            model.load_parameter_tree(
+                jax.tree_util.tree_map(jnp.asarray, saved_params))
+            params = {"embed": embed.parameter_tree(),
+                      "tail": tail.parameter_tree()}
+        same_structure = (jax.tree_util.tree_structure(saved_state)
+                          == jax.tree_util.tree_structure(opt_state))
+        if same_structure:
+            opt_state = jax.tree_util.tree_map(jnp.asarray, saved_state)
+        else:
+            log.warning("optimizer state in %s has a different structure "
+                        "(non-cp training mode?); reinitializing it",
+                        resume_state)
+        start_epoch = int(driver.get("epoch", 1))
+        neval = int(driver.get("neval", 1))
+        log.info("[Resume] context-parallel from %s at epoch %d neval %d",
+                 resume_model, start_epoch, neval)
+
+    def _save_cadence(epoch_done: int) -> None:
+        if not args.checkpoint:
+            return
+        from bigdl_tpu.utils import file_io as fio
+        tag = f".{neval}"
+        fio.save({"params": params, "buffers": {}},
+                 fio.join(args.checkpoint, f"model{tag}"))
+        state_path = fio.join(args.checkpoint, f"state{tag}")
+        fio.save({"optim": opt_state,
+                  "driver": {"epoch": epoch_done + 1, "neval": neval}},
+                 state_path)
+        coordinator.write_marker(
+            state_path, step=neval, epoch=epoch_done + 1,
+            rng_key_data=None, rng_seed=0, epoch_batches=0,
+            epoch_records=0,
+            mesh={"process_count": int(jax.process_count()),
+                  "device_count": int(jax.device_count()),
+                  "mesh_shape": {"seq": n}, "sync_mode": "context-parallel"},
+            cursor_epoch=epoch_done)
+        log.info("[Checkpoint] saved model%s to %s", tag, args.checkpoint)
+
     def tail_loss(p_tail, x_embedded, targets):
         out, _ = functional_apply(tail, p_tail, {}, x_embedded, training=True)
         loss = criterion.apply(out, targets).astype(jnp.float32)
@@ -264,8 +331,7 @@ def _train_context_parallel(model, criterion, ds, args):
         new_p, new_o = method.update(grads, o, p)
         return new_p, new_o, loss
 
-    neval = 1
-    for epoch in range(1, args.maxEpoch + 1):
+    for epoch in range(start_epoch, args.maxEpoch + 1):
         ds.shuffle()
         for batch in ds.data(train=True):
             tokens = jnp.asarray(batch.data)
@@ -276,6 +342,7 @@ def _train_context_parallel(model, criterion, ds, args):
                      " %s)", epoch, neval, float(loss), n,
                      args.contextParallel)
             neval += 1
+        _save_cadence(epoch)
     embed.load_parameter_tree(params["embed"])
     tail.load_parameter_tree(params["tail"])
     return model

@@ -247,6 +247,8 @@ def make_http_server(server: LMServer, host: str, port: int, tokenizer=None):
                     optional "max_new_tokens"
         -> {"ids": [...], "text": "..."?}
     GET  /health    -> {"ok": true, "batches_served": N, "queue_depth": N}
+                       (503 + {"ok": false, "dead": reason} once a
+                       continuous server's worker loop has died)
     GET  /metrics   -> Prometheus text exposition (the server's registry;
                        docs/OBSERVABILITY.md has a scrape_config example)
     """
@@ -278,9 +280,14 @@ def make_http_server(server: LMServer, host: str, port: int, tokenizer=None):
             if self.path != "/health":
                 return self._reply(404,
                                    {"error": "GET /health or /metrics"})
-            self._reply(200, {"ok": True,
-                              "batches_served": server.batches_served,
-                              "queue_depth": server.queue_depth})
+            # a dead continuous server (worker-loop/decode failure) must
+            # flunk the probe so the orchestrator replaces the replica
+            dead = getattr(server, "dead_reason", None)
+            self._reply(503 if dead else 200,
+                        {"ok": dead is None,
+                         "batches_served": server.batches_served,
+                         "queue_depth": server.queue_depth,
+                         **({"dead": dead} if dead else {})})
 
         def do_POST(self):
             if self.path != "/generate":

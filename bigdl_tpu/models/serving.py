@@ -137,6 +137,7 @@ class ContinuousLMServer:
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._stop = threading.Event()
+        self._dead: Optional[str] = None     # set once; never cleared
         self._free = list(range(slots))
         self._active: dict = {}          # slot -> _Slot
         self._last_tok = np.ones((slots,), np.int32)
@@ -157,9 +158,18 @@ class ContinuousLMServer:
         if len(ids) + max_new > self.max_len:
             raise ValueError(f"prompt {len(ids)} + max_new {max_new} "
                              f"exceeds the server max_len {self.max_len}")
+        if self._dead is not None:
+            # fail IMMEDIATELY: a dead worker loop will never drain the
+            # queue, and waiting out the client timeout helps nobody
+            raise RuntimeError(f"server is dead: {self._dead}")
         req = _Request(ids, max_new)
         req.t_submit = time.perf_counter()
         self._queue.put(req)
+        if self._dead is not None and not req.done.is_set():
+            # the worker died between the check and the enqueue; its final
+            # drain may have missed this request — fail it here
+            req.error = f"server is dead: {self._dead}"
+            req.done.set()
         self._tm.serving_queue_depth.set(self._queue.qsize())
         if not req.done.wait(timeout):
             raise TimeoutError("decode did not complete in time")
@@ -171,6 +181,14 @@ class ContinuousLMServer:
     def queue_depth(self) -> int:
         """Requests waiting for a slot (the /health SLO signal)."""
         return self._queue.qsize()
+
+    @property
+    def dead_reason(self) -> Optional[str]:
+        """Why the worker loop stopped serving (None while healthy). Once
+        set, every ``submit()`` raises immediately — restart the server;
+        the donated-buffer state after a decode failure is not
+        recoverable in place."""
+        return self._dead
 
     def close(self):
         self._stop.set()
@@ -342,7 +360,40 @@ class ContinuousLMServer:
             return True
         return False
 
+    def _die(self, reason: str) -> None:
+        """Dead-server state (ADVICE medium, ROADMAP #1): fail every
+        in-flight AND queued request NOW, mark the server dead so later
+        ``submit()`` calls raise immediately instead of queueing against a
+        worker that will never serve them. Never cleared — a decode-step
+        failure invalidates the donated cache buffers, so the only safe
+        continuation is a new server."""
+        self._dead = reason
+        self._tm.serving_request_errors_total.inc(len(self._active))
+        for slot, sl in list(self._active.items()):
+            sl.req.error = f"server died: {reason}"
+            sl.req.done.set()
+            self._free.append(slot)
+        self._active.clear()
+        self._tm.serving_slots_occupied.set(0)
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = f"server is dead: {reason}"
+            req.done.set()
+            self._tm.serving_request_errors_total.inc()
+        self._tm.serving_queue_depth.set(0)
+
     def _run(self):
+        try:
+            self._run_loop()
+        except Exception as e:  # noqa: BLE001 — the worker-thread boundary
+            # an unexpected worker-loop error must not strand clients on
+            # their timeouts: declare the server dead and fail everyone
+            self._die(f"{type(e).__name__}: {e}")
+
+    def _run_loop(self):
         while not self._stop.is_set():
             # strict-FIFO admission into free slots (starvation-free)
             while self._free:
@@ -372,21 +423,16 @@ class ContinuousLMServer:
                         self.params, self.buffers,
                         jnp.asarray(self._last_tok), key)
                     toks = np.asarray(toks)
-            except Exception as e:  # noqa: BLE001 — fail fast, keep serving
-                # a decode-step failure must not kill the worker silently:
-                # every in-flight request fails NOW (clients see the error
-                # instead of hanging to their timeout), the error counter
-                # records the incident, and the loop keeps admitting — if
-                # the donated buffers were invalidated mid-step, the next
-                # admission fails cleanly through _admit's handler too.
-                self._tm.serving_request_errors_total.inc(len(self._active))
-                for slot, sl in list(self._active.items()):
-                    sl.req.error = f"{type(e).__name__}: {e}"
-                    sl.req.done.set()
-                    self._free.append(slot)
-                self._active.clear()
-                self._tm.serving_slots_occupied.set(0)
-                continue
+            except Exception as e:  # noqa: BLE001 — fail fast AND dead
+                # a decode-step failure fails every in-flight request NOW
+                # (clients see the error instead of hanging to their
+                # timeout) and marks the server DEAD: the step donated
+                # self.buffers, so the cache state is gone — "keep
+                # admitting" (the PR-5 behaviour) only converted every
+                # later request into a slower failure. submit() now raises
+                # immediately (ADVICE medium finding, serving.py:302).
+                self._die(f"decode step failed: {type(e).__name__}: {e}")
+                return
             # per-token latency: block wall-clock (np.asarray is the host
             # sync) amortized over the block — one observation per block
             # keeps the hot loop at a few locked ops per decode_block

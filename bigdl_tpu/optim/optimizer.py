@@ -32,6 +32,8 @@ from bigdl_tpu.optim.methods import OptimMethod, SGD
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.triggers import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
+from bigdl_tpu.resilience.preemption import (PreemptionHandler,
+                                             TrainingPreempted)
 from bigdl_tpu.telemetry import get_registry, instruments, span
 from bigdl_tpu.utils import file_io
 from bigdl_tpu.utils.rng import RandomGenerator
@@ -192,6 +194,12 @@ class Optimizer:
         self._grad_clip = {}
         self._steps_per_dispatch = 1
         self._eval_cache = {}  # validation scorer jit, traced once
+        # resilience (bigdl_tpu/resilience, docs/RESILIENCE.md)
+        self._preemption: Optional[PreemptionHandler] = None
+        self._auto_resume = False
+        self._chaos: List = []
+        self._loop_cursor: Optional[Dict] = None  # data-iterator position
+        self._loop_rng = None                     # the loop's key stream
         from bigdl_tpu.ops.precision import DtypePolicy
         self.precision = DtypePolicy.fp32()
 
@@ -356,6 +364,39 @@ class Optimizer:
         self._resume_from = (model_path, state_path)
         return self
 
+    def auto_resume(self, enabled: bool = True) -> "Optimizer":
+        """On ``optimize()``, discover the newest COMPLETE snapshot under
+        ``checkpoint_path`` (partial writes rejected) and continue from it
+        — the relaunch half of preemption survival. A RESUME marker
+        (written by every checkpoint save) restores the data-iterator
+        cursor and the exact per-step key stream, so a mid-epoch restart
+        is bit-exact; the snapshot reshards onto THIS run's mesh even if
+        the process count changed (elastic resume, docs/RESILIENCE.md)."""
+        self._auto_resume = bool(enabled)
+        return self
+
+    def set_preemption_handler(self,
+                               handler: Optional[PreemptionHandler] = None
+                               ) -> "Optimizer":
+        """Install SIGTERM (by default) preemption hooks for the duration
+        of ``optimize()``: on a notice, the loop finishes the step in
+        flight, writes one final snapshot + RESUME marker under
+        ``checkpoint_path`` and raises ``TrainingPreempted`` — at most one
+        step of work is lost (single-host; multi-host runs agree on the
+        snapshot step via a periodic flag all-gather, so loss is bounded
+        by the ``BIGDL_PREEMPT_SYNC_EVERY`` cadence, default 10 steps —
+        set 1 for strict one-step loss at a per-step collective cost)."""
+        self._preemption = handler if handler is not None \
+            else PreemptionHandler()
+        return self
+
+    def set_chaos(self, injectors: Sequence) -> "Optimizer":
+        """Deterministic fault injectors probed at every step boundary
+        (``bigdl_tpu.resilience.chaos``); the env spec ``BIGDL_CHAOS``
+        (e.g. ``kill@5``) adds to these at ``optimize()`` time."""
+        self._chaos = list(injectors)
+        return self
+
     def set_profiling(self, log_dir: str, start_iteration: int = 5,
                       n_iterations: int = 5) -> "Optimizer":
         """Capture a ``jax.profiler`` trace of iterations
@@ -377,6 +418,14 @@ class Optimizer:
         sync mode so local and distributed step breakdowns stay separate
         series in one scrape."""
         return "local"
+
+    def _mesh_descriptor(self) -> Dict[str, Any]:
+        """The topology recorded in RESUME markers — what elastic-resume
+        detection compares against the restarting run's; DistriOptimizer
+        overrides with its mesh shape + sync mode."""
+        return {"process_count": int(jax.process_count()),
+                "device_count": int(jax.device_count()),
+                "mesh_shape": None, "sync_mode": "local"}
 
     def _train_instruments(self):
         """The mode-labeled training metric children (step-time breakdown,
@@ -425,7 +474,33 @@ class Optimizer:
                          file_io.join(self.checkpoint_path, f"model{tag}"))
             file_io.save({"optim": opt_state, "driver": dict(driver_state)},
                          file_io.join(self.checkpoint_path, f"state{tag}"))
+        self._write_resume_marker(driver_state, tag)
         logger.info("[Checkpoint] saved model%s to %s", tag, self.checkpoint_path)
+
+    def _write_resume_marker(self, driver_state, tag: str) -> None:
+        """RESUME marker beside the state snapshot (process 0; written
+        LAST): step/epoch, the loop's exact PRNG key state, the data
+        cursor and this run's mesh shape — what makes the snapshot
+        mid-epoch bit-exact and elastically resumable. No-op outside a
+        live training loop (no cursor yet)."""
+        if self._loop_cursor is None or self._loop_rng is None:
+            return
+        if jax.process_index() != 0:
+            return
+        if "://" in self.checkpoint_path:
+            return  # markers are a local-fs refinement; scheme'd snapshots
+            # resume epoch-granular exactly as before
+        from bigdl_tpu.resilience import coordinator
+        coordinator.write_marker(
+            file_io.join(self.checkpoint_path, f"state{tag}"),
+            step=int(driver_state["neval"]),
+            epoch=int(driver_state["epoch"]),
+            rng_key_data=self._loop_rng.get_key_state(),
+            rng_seed=self._loop_rng.get_seed(),
+            epoch_batches=int(self._loop_cursor["epoch_batches"]),
+            epoch_records=int(self._loop_cursor["epoch_records"]),
+            mesh=self._mesh_descriptor(),
+            cursor_epoch=int(self._loop_cursor["epoch"]))
 
     def _resume_shardings(self, params_tpl, buffers_tpl):
         """Target shardings for a sharded-checkpoint resume: pytrees of
@@ -561,61 +636,78 @@ class LocalOptimizer(Optimizer):
     def optimize(self) -> Module:
         """Train with retry-from-checkpoint (reference
         ``DistriOptimizer.scala:728-796``): on a non-configuration failure,
-        reload the newest snapshot under ``checkpoint_path`` and retry, up to
-        ``BIGDL_FAILURE_RETRY_TIMES`` (default 5) failures inside a sliding
-        ``BIGDL_FAILURE_RETRY_INTERVAL``-second window (default 120)."""
+        reload the newest COMPLETE snapshot under ``checkpoint_path``
+        (partial writes rejected by the resilience coordinator) and retry,
+        up to ``BIGDL_FAILURE_RETRY_TIMES`` (default 5) failures inside a
+        sliding ``BIGDL_FAILURE_RETRY_INTERVAL``-second window (default
+        120). ``TrainingPreempted`` is NOT retried — the host is going
+        away; the snapshot it wrote is picked up by ``auto_resume()`` on
+        relaunch."""
+        from bigdl_tpu.resilience import chaos as chaos_mod
+        from bigdl_tpu.resilience import coordinator
         retry_times = int(os.environ.get("BIGDL_FAILURE_RETRY_TIMES", "5"))
         retry_window = float(
             os.environ.get("BIGDL_FAILURE_RETRY_INTERVAL", "120"))
         failures: List[float] = []
         resume = self._resume_from
-        while True:
-            try:
-                return self._run_training(resume)
-            except (ValueError, TypeError, KeyboardInterrupt):
-                raise  # configuration errors ≙ the reference's IllegalArgument
-            except Exception as e:  # noqa: BLE001 - the retry boundary
-                now = time.time()
-                failures = [t for t in failures if now - t < retry_window]
-                failures.append(now)
-                latest = (self._latest_checkpoint()
-                          if self.checkpoint_path else None)
-                if len(failures) > retry_times or latest is None:
-                    raise
-                resume = latest
-                logger.warning(
-                    "[Retry %d/%d] training failed (%s); restarting from "
-                    "checkpoint %s", len(failures), retry_times, e, latest[0])
+        if resume is None and self._auto_resume:
+            point = coordinator.latest_resume_point(self.checkpoint_path)
+            if point is not None:
+                resume = point
+                logger.info("[AutoResume] discovered snapshot %s",
+                            point.model_path)
+        self._chaos_live = list(self._chaos) + chaos_mod.from_env()
+        handler = self._preemption
+        if handler is not None:
+            handler.install()
+        try:
+            while True:
+                try:
+                    return self._run_training(resume)
+                except (ValueError, TypeError, KeyboardInterrupt,
+                        TrainingPreempted):
+                    raise  # config errors ≙ IllegalArgument; preemption ≙
+                    # the host is being reclaimed — don't spin on it
+                except Exception as e:  # noqa: BLE001 - the retry boundary
+                    now = time.time()
+                    failures = [t for t in failures if now - t < retry_window]
+                    failures.append(now)
+                    latest = (coordinator.latest_resume_point(
+                        self.checkpoint_path) if self.checkpoint_path
+                        else None)
+                    if len(failures) > retry_times or latest is None:
+                        raise
+                    # IN-PROCESS retry: the dataset's in-place shuffle
+                    # order and the host RNG have already advanced past
+                    # their fresh-process state, so the marker's shuffle
+                    # replay + batch-cursor fast-forward would align to
+                    # the wrong permutation (training some records twice,
+                    # skipping others). Drop the marker — the epoch
+                    # restarts from batch 0, the pre-resilience retry
+                    # semantics. A fresh-process relaunch (auto_resume)
+                    # keeps the marker and resumes bit-exact.
+                    import dataclasses
+                    resume = dataclasses.replace(latest, marker=None)
+                    logger.warning(
+                        "[Retry %d/%d] training failed (%s); restarting "
+                        "from checkpoint %s", len(failures), retry_times, e,
+                        latest.model_path)
+        finally:
+            if handler is not None:
+                handler.uninstall()
 
     def _latest_checkpoint(self) -> Optional[Tuple[str, str]]:
-        """Newest (model, state) snapshot pair under ``checkpoint_path``
-        (reference ``getLatestFile``, ``DistriOptimizer.scala:808-825``)."""
-        try:
-            names = file_io.listdir(self.checkpoint_path)
-        except (OSError, NotImplementedError):
+        """Newest COMPLETE (model, state) snapshot pair under
+        ``checkpoint_path`` (reference ``getLatestFile``,
+        ``DistriOptimizer.scala:808-825``; completeness validation in
+        ``bigdl_tpu/resilience/coordinator.py``)."""
+        from bigdl_tpu.resilience import coordinator
+        point = coordinator.latest_resume_point(self.checkpoint_path)
+        if point is None:
             return None
-        pairs = []
-        for name in names:
-            if name == "model" or name.startswith("model."):
-                state_name = "state" + name[len("model"):]
-                if state_name in names:
-                    # order by snapshot number first (reference getLatestFile
-                    # parses the numeric suffix); mtime only breaks ties and
-                    # ranks the suffix-less overwrite-mode "model" pair
-                    try:
-                        neval = int(name[len("model."):])
-                    except ValueError:
-                        neval = -1
-                    path = file_io.join(self.checkpoint_path, name)
-                    pairs.append((neval, file_io.getmtime(path),
-                                  name, state_name))
-        if not pairs:
-            return None
-        _, _, model_name, state_name = max(pairs)
-        return (file_io.join(self.checkpoint_path, model_name),
-                file_io.join(self.checkpoint_path, state_name))
+        return (point.model_path, point.state_path)
 
-    def _run_training(self, resume: Optional[Tuple[str, str]]) -> Module:
+    def _run_training(self, resume) -> Module:
         model = self.model
         # Private copies: the jitted step donates its param/buffer inputs, and
         # donating the model's own arrays would delete buffers any other
@@ -623,8 +715,15 @@ class LocalOptimizer(Optimizer):
         driver_state = T(epoch=1, neval=1)
         driver_state.update(self.state)
 
+        from bigdl_tpu.resilience import coordinator
+        marker = None
         if resume:
-            model_path, state_path = resume
+            if isinstance(resume, coordinator.ResumePoint):
+                model_path, state_path = resume.model_path, resume.state_path
+                marker = resume.marker
+            else:
+                model_path, state_path = resume
+                marker = coordinator.read_marker(state_path)
             from bigdl_tpu.utils import sharded_checkpoint as sckpt
             if sckpt.is_sharded_checkpoint(model_path):
                 params, buffers, opt_state, driver = \
@@ -636,6 +735,17 @@ class LocalOptimizer(Optimizer):
                 st = file_io.load(state_path)
                 opt_state = st["optim"]
                 driver_state.update(st["driver"])
+            elastic = coordinator.is_elastic(marker)
+            instruments(get_registry()).resilience_resumes_total.labels(
+                elastic="unknown" if elastic is None
+                else ("true" if elastic else "false")).inc()
+            if elastic:
+                saved = (marker.get("mesh") or {})
+                logger.info(
+                    "[Resume] ELASTIC: snapshot saved by %s processes / %s "
+                    "devices, resharding onto %d processes / %d devices",
+                    saved.get("process_count"), saved.get("device_count"),
+                    jax.process_count(), jax.device_count())
             logger.info("[Resume] from %s at epoch %s neval %s", model_path,
                         driver_state["epoch"], driver_state["neval"])
         else:
@@ -663,14 +773,54 @@ class LocalOptimizer(Optimizer):
         self._profiling_active = False
         rng = RandomGenerator.RNG()
         from bigdl_tpu.utils.engine import Engine
-        if Engine.process_count() > 1:
+        n_proc = Engine.process_count()
+        if n_proc > 1:
             # SPMD contract: replicated jit inputs (dropout keys) must be
             # identical on every process — sync the stream to process 0's.
             from jax.experimental import multihost_utils
             seed = int(multihost_utils.broadcast_one_to_all(
                 np.asarray(rng.get_seed(), np.int64)))
             rng = RandomGenerator(seed)
+        resume_cursor = None
+        if marker is not None:
+            # Bit-exact mid-epoch restart (docs/RESILIENCE.md): restore the
+            # loop's exact key-stream position, replay the per-epoch
+            # shuffles a fresh process has not performed (the composed
+            # in-place permutation then matches the uninterrupted run —
+            # provided the host RNG is consumed only by these shuffles),
+            # and skip the batches the saved epoch already consumed.
+            key_data = (marker.get("rng") or {}).get("key_data")
+            if key_data:
+                rng = RandomGenerator(int(marker["rng"]["seed"]))
+                rng.set_key_state(key_data)
+            for _ in range(int(driver_state["epoch"]) - 1):
+                self.dataset.shuffle()
+            resume_cursor = dict(marker.get("cursor") or {})
+        self._loop_cursor = None  # set at the first step boundary
+        self._loop_rng = rng
         wall_start = time.time()
+        handler = self._preemption
+        chaos_injectors = getattr(self, "_chaos_live", None)
+        if chaos_injectors is None:
+            chaos_injectors = list(self._chaos)
+        # multi-host preemption must be AGREED: every process snapshots at
+        # the same step or the shard files diverge. A small flag
+        # all-gather decides — but it is a host-blocking cross-host round
+        # trip, so it runs every BIGDL_PREEMPT_SYNC_EVERY steps (default
+        # 10), not every step: a notice still resolves well inside the
+        # grace window, and the hot loop keeps its async pipeline.
+        sync_every = max(1, int(os.environ.get("BIGDL_PREEMPT_SYNC_EVERY",
+                                               "10")))
+
+        def preemption_agreed(neval: int) -> bool:
+            local = handler is not None and handler.should_snapshot()
+            if n_proc <= 1:
+                return local
+            if handler is None or neval % sync_every != 0:
+                return False
+            from jax.experimental import multihost_utils
+            return bool(multihost_utils.process_allgather(
+                np.asarray(1 if local else 0, np.int32)).max())
 
         # One-deep software pipeline: iteration i's loss is fetched AFTER
         # iteration i+1 is dispatched, so the host-side log/summary work and
@@ -780,6 +930,19 @@ class LocalOptimizer(Optimizer):
                 return True
 
             data_iter = iter(self.dataset.data(train=True))
+            epoch_batches = 0
+            if (resume_cursor is not None
+                    and int(resume_cursor.get("epoch", -1)) == epoch):
+                # fast-forward past the batches the preempted run already
+                # trained on: the resumed epoch continues where the
+                # snapshot stopped instead of repeating it
+                skip = int(resume_cursor.get("epoch_batches", 0))
+                for _ in range(skip):
+                    if next(data_iter, None) is None:
+                        break
+                epoch_batches = skip
+                epoch_records = int(resume_cursor.get("epoch_records", 0))
+            resume_cursor = None  # first resumed epoch only
             while True:
                 try:
                     batch = next(data_iter)
@@ -872,12 +1035,29 @@ class LocalOptimizer(Optimizer):
                 if ptrig is not None and ptrig(driver_state):
                     self._summarize_parameters(params, last_neval)
                 driver_state["neval"] = last_neval + 1
+                epoch_batches += k
+                # the data-iterator cursor any checkpoint written at this
+                # boundary records in its RESUME marker
+                self._loop_cursor = {"epoch": epoch,
+                                     "epoch_batches": epoch_batches,
+                                     "epoch_records": epoch_records}
                 if uses_loss_any:
                     # loss-sensitive stop/hook triggers must see THIS
                     # iteration's loss, not the pipelined previous one
                     flush()
                 self._hooks(params, buffers, opt_state, driver_state, fwd,
                             epoch_done=False, flush=flush)
+                for inj in chaos_injectors:
+                    inj.on_step(last_neval)
+                if handler is not None:
+                    fresh = handler.drain_notices()
+                    if fresh:
+                        instruments(get_registry()) \
+                            .resilience_preemptions_total.inc(fresh)
+                if preemption_agreed(last_neval):
+                    flush()
+                    self._preempt_snapshot(params, buffers, opt_state,
+                                           driver_state)
                 if self.end_when(driver_state):  # iteration/loss-based stops
                     stop = True
                     break
@@ -896,6 +1076,43 @@ class LocalOptimizer(Optimizer):
         model.load_parameter_tree(self._finalize_params(params))
         model.load_buffer_tree(buffers)
         return model
+
+    def _preempt_snapshot(self, params, buffers, opt_state,
+                          driver_state) -> None:
+        """End-of-step preemption snapshot: persist (model, state, RESUME
+        marker) through the normal checkpoint machinery, leave the latest
+        weights on the model object, and stop training via
+        ``TrainingPreempted`` (never retried in-process — the host is
+        being reclaimed; ``auto_resume()`` picks the snapshot up on
+        relaunch, possibly on a different process count)."""
+        reason = (self._preemption.reason
+                  if self._preemption is not None and self._preemption.reason
+                  else "preempted")
+        final = self._finalize_params(params)
+        snap_path = None
+        if self.checkpoint_path is not None:
+            t0 = time.time()
+            with span("resilience.snapshot"):
+                self._save_checkpoint(final, buffers, opt_state,
+                                      driver_state)
+            elapsed = time.time() - t0
+            instruments(get_registry()).resilience_snapshot_seconds \
+                .observe(elapsed)
+            tag = ("" if self.is_overwrite
+                   else f".{int(driver_state['neval'])}")
+            snap_path = file_io.join(self.checkpoint_path, f"model{tag}")
+            remaining = (self._preemption.remaining_grace()
+                         if self._preemption is not None else float("inf"))
+            logger.warning(
+                "[Preempted] %s: snapshot %s written in %.2fs (grace "
+                "remaining %.1fs); relaunch with auto_resume() to continue",
+                reason, snap_path, elapsed, remaining)
+        else:
+            logger.warning("[Preempted] %s: no checkpoint path configured "
+                           "— stopping WITHOUT a snapshot", reason)
+        self.model.load_parameter_tree(final)
+        self.model.load_buffer_tree(buffers)
+        raise TrainingPreempted(reason, snap_path)
 
     def _summarize_parameters(self, params, neval: int) -> None:
         """Per-parameter histograms (reference ``TrainSummary`` "Parameters"

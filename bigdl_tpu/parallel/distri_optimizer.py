@@ -99,6 +99,17 @@ class DistriOptimizer(LocalOptimizer):
         local loop's ``mode="local"`` (docs/OBSERVABILITY.md)."""
         return f"mesh-{self.sync_mode}"
 
+    def _mesh_descriptor(self):
+        """RESUME-marker topology record: elastic-resume detection compares
+        the saving run's process/device counts against the restarting
+        run's, and the mesh shape documents what the snapshot's shard
+        layout meant (docs/RESILIENCE.md)."""
+        return {"process_count": int(jax.process_count()),
+                "device_count": int(jax.device_count()),
+                "mesh_shape": {ax: int(n)
+                               for ax, n in self.mesh.shape.items()},
+                "sync_mode": self.sync_mode}
+
     # ------------------------------------------------------------- placement
     def _place_batch(self, batch):
         """Commit one batch onto the mesh's data axis.

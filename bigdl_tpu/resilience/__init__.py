@@ -1,0 +1,41 @@
+"""bigdl_tpu.resilience — preemption-aware, elastically resumable training.
+
+TPU pods preempt; the reference framework only retried after a crash,
+losing everything since the last periodic checkpoint and resuming only
+on the same cluster shape (PAPER.md §5.3). This subsystem makes training
+survive preemption with at-most-one-step loss:
+
+- ``preemption``: SIGTERM/SIGINT hooks + a cooperative
+  ``should_snapshot()`` flag the training loop polls at step boundaries;
+  on a notice it writes one final snapshot + RESUME marker and raises
+  ``TrainingPreempted``.
+- ``coordinator``: discovers the newest COMPLETE snapshot (manifest-
+  validated; partial writes rejected), reads/writes RESUME markers
+  (step, epoch, RNG key state, data cursor, mesh shape) and detects
+  elastic restarts — resuming onto a DIFFERENT process count, which the
+  resharding restore in ``utils/sharded_checkpoint.py`` makes exact.
+- ``chaos``: deterministic kill-at-step / delay / corrupt-shard
+  injectors (``scripts/bigdl-tpu.sh chaos``) keeping the recovery paths
+  honest.
+
+Wire-up: ``Optimizer.set_preemption_handler().auto_resume()`` (see
+``docs/RESILIENCE.md``); metrics ``bigdl_resilience_*`` in the telemetry
+catalogue.
+"""
+
+from bigdl_tpu.resilience import chaos, coordinator
+from bigdl_tpu.resilience.chaos import (DelayAtStep, KillAtStep,
+                                        corrupt_snapshot)
+from bigdl_tpu.resilience.coordinator import (ResumePoint, is_elastic,
+                                              latest_resume_point,
+                                              read_marker, validate_pair,
+                                              write_marker)
+from bigdl_tpu.resilience.preemption import (PreemptionHandler,
+                                             TrainingPreempted)
+
+__all__ = [
+    "PreemptionHandler", "TrainingPreempted", "ResumePoint",
+    "latest_resume_point", "validate_pair", "write_marker", "read_marker",
+    "is_elastic", "KillAtStep", "DelayAtStep", "corrupt_snapshot",
+    "chaos", "coordinator",
+]

@@ -1,0 +1,120 @@
+"""CLI: ``python -m bigdl_tpu.resilience`` (``scripts/bigdl-tpu.sh chaos``).
+
+Subcommands (all filesystem-only — no device/backend touch):
+
+- ``validate <checkpoint_dir>``: list every snapshot pair with its
+  complete/partial verdict and marker summary; exit 0 iff a resume point
+  exists.
+- ``latest <checkpoint_dir>``: print the newest complete (model, state)
+  pair, one path per line (for shell scripting).
+- ``chaos corrupt <snapshot_dir> [--shard N] [--mode flip|truncate|
+  delete] [--seed S]``: deterministically damage a shard file (drills
+  the partial-snapshot rejection path).
+- ``chaos selftest``: exercise the injectors deterministically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from bigdl_tpu.resilience import chaos as chaos_mod
+from bigdl_tpu.resilience import coordinator
+
+
+def _cmd_validate(args) -> int:
+    import os
+    pairs = coordinator.snapshot_pairs(args.checkpoint_dir)
+    if not pairs:
+        print(f"no snapshot pairs under {args.checkpoint_dir}")
+        return 1
+    any_ok = False
+    for neval, _, model_name, state_name in reversed(pairs):
+        model = os.path.join(args.checkpoint_dir, model_name)
+        state = os.path.join(args.checkpoint_dir, state_name)
+        ok = coordinator.validate_pair(model, state)
+        any_ok = any_ok or ok
+        marker = coordinator.read_marker(state) if ok else None
+        tag = "complete" if ok else "PARTIAL "
+        extra = ""
+        if marker:
+            mesh = marker.get("mesh") or {}
+            extra = (f"  marker: step {marker.get('step')} epoch "
+                     f"{marker.get('epoch')} procs "
+                     f"{mesh.get('process_count')}")
+        print(f"{tag}  {model_name} / {state_name}"
+              f" (neval {neval}){extra}")
+    return 0 if any_ok else 1
+
+
+def _cmd_latest(args) -> int:
+    point = coordinator.latest_resume_point(args.checkpoint_dir)
+    if point is None:
+        print("no complete snapshot", file=sys.stderr)
+        return 1
+    print(point.model_path)
+    print(point.state_path)
+    return 0
+
+
+def _cmd_chaos_corrupt(args) -> int:
+    info = chaos_mod.corrupt_snapshot(args.snapshot_dir, shard=args.shard,
+                                      mode=args.mode, seed=args.seed)
+    print(f"corrupted {info['file']} ({info['mode']})")
+    return 0
+
+
+def _cmd_chaos_selftest(args) -> int:
+    del args
+    fired = []
+    k = chaos_mod.KillAtStep(3, sig=0, _kill=lambda pid, sig: fired.append(3))
+    for step in range(1, 6):
+        k.on_step(step)
+    assert fired == [3], fired
+    slept = []
+    d = chaos_mod.DelayAtStep(2, 0.25, _sleep=slept.append)
+    for step in range(1, 6):
+        d.on_step(step)
+    assert slept == [0.25], slept
+    specs = [chaos_mod.parse_spec(s) for s in
+             ("kill@5", "kill@7:SIGINT", "delay@3:0.5")]
+    assert [type(s).__name__ for s in specs] == ["KillAtStep", "KillAtStep",
+                                                 "DelayAtStep"]
+    print("chaos selftest: kill-at-step fired once at 3; delay slept 0.25s "
+          "at 2; spec parsing ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.resilience",
+        description="snapshot validation + fault-injection tooling "
+                    "(docs/RESILIENCE.md)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("validate", help="audit a checkpoint directory")
+    p.add_argument("checkpoint_dir")
+    p.set_defaults(fn=_cmd_validate)
+
+    p = sub.add_parser("latest", help="print the newest complete pair")
+    p.add_argument("checkpoint_dir")
+    p.set_defaults(fn=_cmd_latest)
+
+    p = sub.add_parser("chaos", help="fault injection")
+    csub = p.add_subparsers(dest="chaos_cmd", required=True)
+    c = csub.add_parser("corrupt", help="damage one shard file")
+    c.add_argument("snapshot_dir")
+    c.add_argument("--shard", type=int, default=0)
+    c.add_argument("--mode", default="flip",
+                   choices=["flip", "truncate", "delete"])
+    c.add_argument("--seed", type=int, default=0)
+    c.set_defaults(fn=_cmd_chaos_corrupt)
+    c = csub.add_parser("selftest", help="deterministic injector check")
+    c.set_defaults(fn=_cmd_chaos_selftest)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

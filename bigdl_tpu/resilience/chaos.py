@@ -1,0 +1,149 @@
+"""Deterministic fault injection for resilience tests and drills.
+
+Retry loops and resume coordinators rot unless something exercises them;
+these injectors make the failure REPRODUCIBLE — kill exactly at step N,
+stall exactly at step N, corrupt exactly the same bytes of a snapshot —
+so a recovery test failing once fails every time:
+
+- ``KillAtStep`` / ``DelayAtStep``: step-boundary injectors the
+  optimizer polls (``set_chaos([...])`` or env ``BIGDL_CHAOS``,
+  e.g. ``BIGDL_CHAOS="kill@5"`` or ``"delay@3:0.25,kill@7:SIGINT"``);
+  a kill delivers a REAL signal to this process, driving the installed
+  ``PreemptionHandler`` through the same path a platform preemption
+  takes.
+- ``corrupt_snapshot``: deterministic shard-file corruption (flip bytes
+  seeded, truncate, or delete) against a sharded snapshot dir — what the
+  partial-snapshot-rejection tests and ``scripts/bigdl-tpu.sh chaos
+  corrupt`` feed the coordinator.
+
+jax-free; importable by the CLI on a bare host.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import List, Optional
+
+__all__ = ["KillAtStep", "DelayAtStep", "corrupt_snapshot", "parse_spec",
+           "from_env"]
+
+
+class KillAtStep:
+    """Deliver ``sig`` to this process the FIRST time the training loop
+    completes step ``step`` — a deterministic stand-in for the platform's
+    preemption notice. ``_kill`` is injectable for selftests."""
+
+    def __init__(self, step: int, sig: int = signal.SIGTERM, _kill=os.kill):
+        if step < 1:
+            raise ValueError(f"step must be >= 1, got {step}")
+        self.step = int(step)
+        self.sig = int(sig)
+        self.fired = False
+        self._kill = _kill
+
+    def on_step(self, neval: int) -> None:
+        if not self.fired and neval >= self.step:
+            self.fired = True
+            self._kill(os.getpid(), self.sig)
+
+    def __repr__(self):
+        return f"KillAtStep(step={self.step}, sig={self.sig})"
+
+
+class DelayAtStep:
+    """Stall the host for ``seconds`` the first time step ``step``
+    completes (straggler / slow-host simulation)."""
+
+    def __init__(self, step: int, seconds: float, _sleep=time.sleep):
+        if step < 1:
+            raise ValueError(f"step must be >= 1, got {step}")
+        self.step = int(step)
+        self.seconds = float(seconds)
+        self.fired = False
+        self._sleep = _sleep
+
+    def on_step(self, neval: int) -> None:
+        if not self.fired and neval >= self.step:
+            self.fired = True
+            self._sleep(self.seconds)
+
+    def __repr__(self):
+        return f"DelayAtStep(step={self.step}, seconds={self.seconds})"
+
+
+def corrupt_snapshot(path: str, shard: int = 0, mode: str = "flip",
+                     seed: int = 0, nbytes: int = 64) -> dict:
+    """Deterministically damage one shard file of a sharded snapshot dir.
+
+    ``mode='flip'``: XOR ``nbytes`` bytes at positions drawn from
+    ``default_rng(seed)`` (same seed -> same bytes, every time);
+    ``'truncate'``: drop the file's second half; ``'delete'``: remove it.
+    Returns a description dict (file, mode, positions) for logging."""
+    import numpy as np  # heavier import kept out of module load
+
+    from bigdl_tpu.utils.sharded_checkpoint import read_manifest
+
+    leaves_, shards = read_manifest(path)
+    del leaves_
+    if shards is None:
+        shards = sorted(f for f in os.listdir(path)
+                        if f.startswith("shard-") and f.endswith(".npz"))
+    if not 0 <= shard < len(shards):
+        raise ValueError(f"shard {shard} out of range; snapshot has "
+                         f"{len(shards)} shard files")
+    target = os.path.join(path, shards[shard])
+    info = {"file": target, "mode": mode}
+    if mode == "delete":
+        os.unlink(target)
+        return info
+    size = os.path.getsize(target)
+    if mode == "truncate":
+        with open(target, "r+b") as f:
+            f.truncate(size // 2)
+        info["truncated_to"] = size // 2
+        return info
+    if mode != "flip":
+        raise ValueError(f"unknown mode {mode!r}; use flip|truncate|delete")
+    rng = np.random.default_rng(seed)
+    positions = sorted(int(p) for p in
+                       rng.integers(0, max(1, size), size=min(nbytes, size)))
+    with open(target, "r+b") as f:
+        for pos in positions:
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([b[0] ^ 0xFF]))
+    info["positions"] = positions
+    return info
+
+
+def parse_spec(spec: str):
+    """One injector from ``kind@step[:arg]``: ``kill@5``,
+    ``kill@7:SIGINT``, ``delay@3:0.25``."""
+    kind, _, rest = spec.strip().partition("@")
+    step_s, _, arg = rest.partition(":")
+    try:
+        step = int(step_s)
+    except ValueError:
+        raise ValueError(f"bad chaos spec {spec!r}: expected kind@step"
+                         f"[:arg]") from None
+    if kind == "kill":
+        sig = signal.SIGTERM
+        if arg:
+            name = arg if arg.startswith("SIG") else "SIG" + arg
+            sig = getattr(signal, name)
+        return KillAtStep(step, sig)
+    if kind == "delay":
+        return DelayAtStep(step, float(arg or "1.0"))
+    raise ValueError(f"unknown chaos injector {kind!r} in {spec!r}")
+
+
+def from_env(var: str = "BIGDL_CHAOS") -> List["KillAtStep"]:
+    """Injectors from a comma-separated env spec (empty -> none) — lets
+    launcher-level drills inject faults without touching code."""
+    spec = os.environ.get(var, "").strip()
+    if not spec:
+        return []
+    return [parse_spec(s) for s in spec.split(",") if s.strip()]

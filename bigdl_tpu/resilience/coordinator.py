@@ -1,0 +1,276 @@
+"""Resume coordination: discover the newest COMPLETE snapshot, reject
+partial writes, and restart — possibly on a different process count.
+
+The optimizer's crash-retry loop used to trust the newest
+``model.N``/``state.N`` pair by name; a writer killed mid-save (the exact
+failure preemption produces) would leave a half-written snapshot that the
+retry then crashed on. This module is the validating replacement:
+
+- ``latest_resume_point(path)`` walks snapshot pairs newest-first
+  (numeric ``neval`` tag first, mtime as tie-break — the reference's
+  ``getLatestFile`` order) and returns the first COMPLETE one as a
+  ``ResumePoint``; partial snapshots are skipped, not fatal.
+- completeness for a sharded snapshot = ``manifest.json`` present AND
+  every shard file the manifest names present (manifest format 2,
+  ``utils/sharded_checkpoint.py``; both the model and state dirs must
+  pass, plus ``driver.json``). Shards and manifest are written via
+  tmp+rename, so presence == fully written. Plain (single-file)
+  snapshots: both files exist and are non-empty.
+- the RESUME marker (``resume.json`` beside the state snapshot) records
+  step/epoch, the loop's exact PRNG key state, the data-iterator cursor
+  and the saving run's mesh shape — what ``_run_training`` needs for a
+  bit-exact mid-epoch restart, and what elastic detection compares
+  against the CURRENT topology (``is_elastic``). Markers are optional:
+  a pair without one still resumes, epoch-granular, like before.
+
+Filesystem-only (no jax at import): the CLI (``python -m
+bigdl_tpu.resilience validate``) runs on a bare host in milliseconds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+MARKER_NAME = "resume.json"
+MARKER_FORMAT = 1
+
+
+# --------------------------------------------------------------- the marker
+def _marker_path(state_path: str) -> str:
+    if os.path.isdir(state_path):
+        return os.path.join(state_path, MARKER_NAME)
+    return state_path + "." + MARKER_NAME
+
+
+def write_marker(state_path: str, *, step: int, epoch: int,
+                 rng_key_data: Optional[List[int]], rng_seed: int,
+                 epoch_batches: int, epoch_records: int,
+                 mesh: Dict[str, Any],
+                 cursor_epoch: Optional[int] = None) -> str:
+    """Atomically write the RESUME marker beside ``state_path`` (inside a
+    sharded state dir, or as ``<file>.resume.json`` for a plain one).
+    Call from process 0 only; written LAST, after the snapshot itself, so
+    a marker's presence implies the saver got that far. ``cursor_epoch``
+    is the epoch the batch counts refer to — at an epoch-boundary save it
+    is the FINISHED epoch while ``epoch`` already names the next one, and
+    the resuming loop only skips batches when they match."""
+    marker = {
+        "format": MARKER_FORMAT,
+        "step": int(step),
+        "epoch": int(epoch),
+        "rng": {"key_data": rng_key_data, "seed": int(rng_seed)},
+        "cursor": {"epoch": int(epoch if cursor_epoch is None
+                                else cursor_epoch),
+                   "epoch_batches": int(epoch_batches),
+                   "epoch_records": int(epoch_records)},
+        "mesh": mesh,
+        "complete": True,
+    }
+    path = _marker_path(state_path)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(marker, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_marker(state_path: str) -> Optional[Dict[str, Any]]:
+    """The RESUME marker for a state snapshot, or None (absent marker is
+    legal — pre-resilience snapshots resume epoch-granular; an unreadable
+    or incomplete one reads as absent too)."""
+    path = _marker_path(state_path)
+    try:
+        with open(path) as f:
+            marker = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(marker, dict) or not marker.get("complete"):
+        return None
+    return marker
+
+
+# ------------------------------------------------------------- completeness
+def sharded_snapshot_complete(path: str) -> bool:
+    """Manifest present and every shard file it names present (format 2).
+    Format-1 manifests (no shard list) are complete when at least one
+    shard file exists — the strongest check that format allows."""
+    manifest_path = os.path.join(path, "manifest.json")
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return False
+    shards = (manifest.get("shards")
+              if isinstance(manifest, dict) and "leaves" in manifest
+              else None)
+    if shards is None:
+        return any(f.startswith("shard-") and f.endswith(".npz")
+                   for f in os.listdir(path))
+    return all(os.path.exists(os.path.join(path, s)) for s in shards)
+
+
+def validate_pair(model_path: str, state_path: str) -> bool:
+    """Is (model, state) a complete, restartable snapshot?"""
+    if "://" in model_path:
+        # scheme'd (utils/file_io) plain snapshots: existence is the
+        # strongest check the handler contract offers
+        from bigdl_tpu.utils import file_io
+        try:
+            return file_io.exists(model_path) and file_io.exists(state_path)
+        except NotImplementedError:
+            return True  # no exists hook — keep the legacy trust-by-name
+    if os.path.isdir(model_path):
+        return (sharded_snapshot_complete(model_path)
+                and os.path.isdir(state_path)
+                and sharded_snapshot_complete(state_path)
+                and os.path.exists(os.path.join(state_path, "driver.json")))
+    try:
+        return (os.path.getsize(model_path) > 0
+                and os.path.getsize(state_path) > 0)
+    except OSError:
+        return False
+
+
+# ---------------------------------------------------------------- discovery
+@dataclass
+class ResumePoint:
+    """One validated restart point under a checkpoint directory."""
+
+    model_path: str
+    state_path: str
+    neval: int                                  # numeric tag; -1 = untagged
+    sharded: bool
+    marker: Optional[Dict[str, Any]] = field(default=None, repr=False)
+
+    def saved_mesh(self) -> Optional[Dict[str, Any]]:
+        return (self.marker or {}).get("mesh")
+
+
+def _listdir(path: str) -> List[str]:
+    # scheme'd checkpoint paths (utils/file_io registry) keep working for
+    # PLAIN snapshot discovery; local paths stay stdlib-only so the CLI
+    # does not pull the jax-backed IO layer
+    if "://" in path:
+        from bigdl_tpu.utils import file_io
+        return file_io.listdir(path)
+    return os.listdir(path)
+
+
+def _mtime(path: str) -> float:
+    if "://" in path:
+        from bigdl_tpu.utils import file_io
+        return file_io.getmtime(path)
+    return os.path.getmtime(path)
+
+
+def _join(base: str, name: str) -> str:
+    if "://" in base:
+        return base.rstrip("/") + "/" + name
+    return os.path.join(base, name)
+
+
+def snapshot_pairs(checkpoint_path: str) -> List[Tuple[int, float, str, str]]:
+    """All (neval, mtime, model_name, state_name) snapshot pairs under
+    ``checkpoint_path``, best-last (numeric tag order, mtime tie-break —
+    the reference ``getLatestFile`` order, ``DistriOptimizer.scala:808``)."""
+    try:
+        names = set(_listdir(checkpoint_path))
+    except (OSError, NotImplementedError):
+        return []
+    pairs = []
+    for name in names:
+        if name != "model" and not name.startswith("model."):
+            continue
+        state_name = "state" + name[len("model"):]
+        if state_name not in names:
+            continue
+        try:
+            neval = int(name[len("model."):])
+        except ValueError:
+            neval = -1
+        try:
+            mtime = _mtime(_join(checkpoint_path, name))
+        except OSError:
+            continue
+        pairs.append((neval, mtime, name, state_name))
+    pairs.sort()
+    return pairs
+
+
+def latest_resume_point(checkpoint_path: Optional[str]) -> Optional[ResumePoint]:
+    """The newest COMPLETE snapshot pair, or None. Partial pairs (a save
+    killed mid-write) are skipped in favour of the previous complete one —
+    the retry/auto-resume contract that makes preemption survivable."""
+    if not checkpoint_path:
+        return None
+    for neval, _, model_name, state_name in reversed(
+            snapshot_pairs(checkpoint_path)):
+        model_path = _join(checkpoint_path, model_name)
+        state_path = _join(checkpoint_path, state_name)
+        if not validate_pair(model_path, state_path):
+            continue
+        return ResumePoint(model_path=model_path, state_path=state_path,
+                           neval=neval, sharded=os.path.isdir(model_path),
+                           marker=read_marker(state_path))
+    return None
+
+
+# ------------------------------------------------------------------ elastic
+def current_mesh_descriptor() -> Dict[str, Any]:
+    """The CURRENT topology in marker ``mesh`` form (imports jax lazily)."""
+    import jax
+    return {"process_count": int(jax.process_count()),
+            "device_count": int(jax.device_count()),
+            "mesh_shape": None, "sync_mode": None}
+
+
+def is_elastic(marker: Optional[Dict[str, Any]]) -> Optional[bool]:
+    """Did the topology change between save and resume? None when the
+    marker is absent or carries no mesh record (unknowable)."""
+    mesh = (marker or {}).get("mesh") or {}
+    if "process_count" not in mesh:
+        return None
+    import jax
+    return (int(mesh["process_count"]) != int(jax.process_count())
+            or int(mesh.get("device_count", jax.device_count()))
+            != int(jax.device_count()))
+
+
+# ------------------------------------------------- host-side snapshot loads
+def manifest_leaf_keys(path: str) -> List[str]:
+    """Leaf key paths stored in a sharded snapshot (format 1 or 2)."""
+    from bigdl_tpu.utils.sharded_checkpoint import read_manifest
+    leaves, _ = read_manifest(path)
+    return list(leaves)
+
+
+def load_snapshot_host(model_path: str, state_path: str,
+                       params_template: Any, state_template: Any):
+    """(params, opt_state, driver) restored to HOST values from either a
+    plain or a sharded snapshot pair — the path for custom training loops
+    (``apps/transformer.py --contextParallel``) that do not go through
+    ``Optimizer.resume``. Templates supply the pytree structures a
+    sharded restore needs."""
+    import jax
+
+    from bigdl_tpu.utils import file_io
+    from bigdl_tpu.utils import sharded_checkpoint as sckpt
+
+    if sckpt.is_sharded_checkpoint(model_path):
+        none_of = lambda t: jax.tree_util.tree_map(lambda _: None, t)
+        snap = sckpt.load_sharded(
+            model_path, {"params": none_of(params_template),
+                         "buffers": {}})
+        st = sckpt.load_sharded(state_path,
+                                {"optim": none_of(state_template)})
+        with open(os.path.join(state_path, "driver.json")) as f:
+            driver = json.load(f)
+        return snap["params"], st["optim"], driver
+    snap = file_io.load(model_path)
+    if not isinstance(snap, dict):      # a saved Module (model_final style)
+        snap = {"params": snap.parameter_tree()}
+    st = file_io.load(state_path)
+    return snap["params"], st["optim"], dict(st.get("driver", {}))

@@ -104,6 +104,18 @@ METRIC_SPECS: List[MetricSpec] = [
                "Host wall-clock per evaluation batch (async dispatch in "
                "the device-accumulation steady state).",
                (), DEFAULT_LATENCY_BUCKETS),
+    # ---- resilience (bigdl_tpu/resilience/, docs/RESILIENCE.md)
+    MetricSpec("bigdl_resilience_preemptions_total", "counter",
+               "Preemption notices received (SIGTERM/SIGINT or a "
+               "cooperative chaos/test trigger)."),
+    MetricSpec("bigdl_resilience_snapshot_seconds", "histogram",
+               "Wall-clock of the end-of-step preemption snapshot "
+               "(model + state + RESUME marker).",
+               (), DEFAULT_LATENCY_BUCKETS + (30.0, 120.0)),
+    MetricSpec("bigdl_resilience_resumes_total", "counter",
+               "Training restarts from a discovered snapshot; "
+               "elastic=true when the process/device count changed "
+               "(unknown = markerless legacy snapshot).", ("elastic",)),
     # ---- legacy bridge (optim/metrics.py)
     MetricSpec("bigdl_legacy_metric", "gauge",
                "Legacy optim.Metrics counters bridged onto the registry "
@@ -131,6 +143,8 @@ SPAN_SPECS: List[Tuple[str, str]] = [
      "enqueue)."),
     ("train.sync", "Blocking fetch of the pipelined window losses."),
     ("train.validate", "In-training validation pass."),
+    ("resilience.snapshot", "End-of-step preemption snapshot: model + "
+     "state + RESUME marker (optim/optimizer.py)."),
     ("eval.batches", "One evaluate_batches call (all batches + the final "
      "device->host merge)."),
 ]

@@ -139,9 +139,21 @@ class Engine:
             _state.dist_checked = True
             return
         import jax
-        if jax.distributed.is_initialized():
+        # jax < 0.5 has no jax.distributed.is_initialized; _state.dist_checked
+        # already makes this call once-per-process, so absence just means we
+        # proceed straight to initialize
+        is_init = getattr(jax.distributed, "is_initialized", None)
+        if is_init is not None and is_init():
             _state.dist_checked = True
             return
+        # CPU multi-process collectives need the gloo implementation
+        # selected BEFORE the backend initializes (jax >= 0.4.34 otherwise
+        # refuses cross-process computations on CPU); a no-op on TPU pods
+        # and on jax versions without the option.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # unknown option on this jax — leave defaults
+            pass
         # A genuine connect failure must RAISE: swallowing it would let N
         # hosts silently train independently against one checkpoint path.
         if coord:

@@ -51,6 +51,21 @@ class RandomGenerator:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def get_key_state(self):
+        """The current JAX key's raw counter words as a plain int list —
+        JSON-able, for RESUME markers (``bigdl_tpu/resilience``): restoring
+        it replays the exact key stream position, so a resumed run draws
+        the same per-step dropout keys an uninterrupted run would."""
+        return [int(w) for w in
+                np.asarray(jax.random.key_data(self._key)).ravel()]
+
+    def set_key_state(self, words) -> "RandomGenerator":
+        """Restore a key captured by ``get_key_state`` (same impl only)."""
+        data = np.asarray(words, np.uint32)
+        shape = np.shape(np.asarray(jax.random.key_data(self._key)))
+        self._key = jax.random.wrap_key_data(data.reshape(shape))
+        return self
+
     # -- host-side draws (numpy-backed; used by data pipeline / init) --------
     def uniform(self, low: float = 0.0, high: float = 1.0, size=None):
         return self._np.uniform(low, high, size)

@@ -24,6 +24,20 @@ Format: numpy ``.npz`` members keyed ``<leafpath>||<offsets>||<shape>``,
 where offsets/shape locate the slab in the global array. Plain-host leaves
 (numpy, scalars) are written by process 0 with offset 0.
 
+``manifest.json`` (format 2) names the participating shard files::
+
+    {"format": 2, "shards": ["shard-00000.npz", ...], "leaves": {...}}
+
+so restore reads EXACTLY the files this save wrote — a snapshot directory
+reused by a run with fewer processes no longer resurrects stale
+``shard-*.npz`` slabs from the earlier, wider run (process 0 also deletes
+non-participating shard files up front). Shard files and the manifest are
+written via tmp-file + ``os.replace``, so a file visible under its final
+name is complete: a writer killed mid-save leaves either a missing shard
+or a missing manifest, both of which the resilience coordinator
+(``bigdl_tpu/resilience/coordinator.py``) rejects as a partial snapshot.
+Format-1 manifests (a bare leaves dict) remain loadable.
+
 Wired into ``DistriOptimizer`` via ``set_checkpoint(..., sharded=True)``
 and auto-detected on ``resume()`` (a checkpoint directory containing
 ``manifest.json``).
@@ -56,19 +70,47 @@ def _parse_slab(name: str):
     return key, to_tuple(offs), to_tuple(shape)
 
 
+def shard_filename(pidx: int) -> str:
+    return f"shard-{pidx:05d}.npz"
+
+
+def _atomic_write_npz(path: str, blobs) -> None:
+    # tmp + os.replace: a crash mid-write leaves no file under the final
+    # name, so presence == completeness. savez gets a FILE OBJECT — passing
+    # a name would make numpy append ".npz" to the tmp suffix.
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **blobs)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def save_sharded(path: str, tree: Any) -> None:
     """Write this process's shards of ``tree`` under ``path`` (a directory).
     Call from EVERY process; collective-free (each process writes only
     local data)."""
     os.makedirs(path, exist_ok=True)
     pidx = jax.process_index()
+    nproc = jax.process_count()
+    shard_names = [shard_filename(i) for i in range(nproc)]
+    if pidx == 0:
+        # clear stale shards from an earlier, WIDER save into this dir:
+        # no current process writes those names, so the delete cannot race
+        # a live writer (ADVICE: the stale-shard overwrite hazard)
+        for fname in os.listdir(path):
+            if (fname.startswith("shard-") and fname.endswith(".npz")
+                    and fname not in shard_names):
+                os.unlink(os.path.join(path, fname))
     blobs = {}
-    manifest = {}
+    leaves = {}
     for keypath, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         key = _leaf_key(keypath)
         if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
-            manifest[key] = {"shape": list(leaf.shape),
-                             "dtype": str(leaf.dtype)}
+            leaves[key] = {"shape": list(leaf.shape),
+                           "dtype": str(leaf.dtype)}
             for sh in leaf.addressable_shards:
                 if sh.replica_id != 0:
                     continue  # exactly-once: the 0th replica owns the slab
@@ -77,14 +119,17 @@ def save_sharded(path: str, tree: Any) -> None:
                 blobs[_slab_name(key, offs, data.shape)] = data
         else:
             arr = np.asarray(leaf)
-            manifest[key] = {"shape": list(arr.shape),
-                             "dtype": str(arr.dtype)}
+            leaves[key] = {"shape": list(arr.shape),
+                           "dtype": str(arr.dtype)}
             if pidx == 0:  # host value: identical everywhere, store once
                 blobs[_slab_name(key, (0,) * arr.ndim, arr.shape)] = arr
-    np.savez(os.path.join(path, f"shard-{pidx:05d}.npz"), **blobs)
+    _atomic_write_npz(os.path.join(path, shard_filename(pidx)), blobs)
     if pidx == 0:
-        with open(os.path.join(path, "manifest.json"), "w") as f:
+        manifest = {"format": 2, "shards": shard_names, "leaves": leaves}
+        tmp = os.path.join(path, f".manifest.tmp-{os.getpid()}")
+        with open(tmp, "w") as f:
             json.dump(manifest, f)
+        os.replace(tmp, os.path.join(path, "manifest.json"))
 
 
 def is_sharded_checkpoint(path: str) -> bool:
@@ -92,14 +137,35 @@ def is_sharded_checkpoint(path: str) -> bool:
         os.path.join(path, "manifest.json"))
 
 
-def _slab_index(path: str):
-    """key -> [(npz_file, member_name, offsets, shape)] across all shard
-    files (reads only the zip directories, not the data)."""
+def read_manifest(path: str):
+    """(leaves, shard_names) from ``manifest.json``. Format 2 names its
+    participating shard files; format 1 (a bare leaves dict) returns
+    ``shard_names=None`` — restore then globs, the pre-fix behaviour."""
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    if isinstance(manifest, dict) and "leaves" in manifest:
+        return manifest["leaves"], manifest.get("shards")
+    return manifest, None
+
+
+def _slab_index(path: str, shard_names=None):
+    """key -> [(npz_file, member_name, offsets, shape)] across the shard
+    files (reads only the zip directories, not the data). With
+    ``shard_names`` (manifest format 2), ONLY those files are read —
+    stale shards from an earlier save into the same dir are invisible;
+    a missing participant is an incomplete snapshot."""
+    if shard_names is None:
+        shard_names = sorted(
+            f for f in os.listdir(path)
+            if f.startswith("shard-") and f.endswith(".npz"))
     index = {}
-    for fname in sorted(os.listdir(path)):
-        if not fname.startswith("shard-") or not fname.endswith(".npz"):
-            continue
+    for fname in shard_names:
         full = os.path.join(path, fname)
+        if not os.path.exists(full):
+            raise ValueError(
+                f"snapshot {path} is incomplete: manifest names {fname} "
+                "but the file is missing (writer killed mid-save, or not "
+                "all processes' shard files were copied)")
         with np.load(full) as z:
             names = list(z.files)
         for name in names:
@@ -113,9 +179,8 @@ def load_sharded(path: str, shardings: Any) -> Any:
     ``jax.sharding.Sharding`` — or ``None`` leaves for host numpy arrays —
     with the SAME tree structure as the saved tree). Each process reads
     only the slabs overlapping its addressable shards."""
-    with open(os.path.join(path, "manifest.json")) as f:
-        manifest = json.load(f)
-    index = _slab_index(path)
+    manifest, shard_names = read_manifest(path)
+    index = _slab_index(path, shard_names)
     open_files: dict = {}
 
     def read_block(key, dtype, starts, stops):

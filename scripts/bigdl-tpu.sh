@@ -7,6 +7,8 @@
 #   ./scripts/bigdl-tpu.sh lint [paths... --select/--ignore/--format ...]
 #   ./scripts/bigdl-tpu.sh metrics [url|--selftest]   # scrape /metrics
 #   ./scripts/bigdl-tpu.sh trace [file|--selftest]    # Chrome trace tools
+#   ./scripts/bigdl-tpu.sh chaos {corrupt|selftest} ...  # fault injection
+#   ./scripts/bigdl-tpu.sh resilience {validate|latest} <ckpt_dir>
 set -euo pipefail
 
 # --- lint subcommand: graftlint, the AST-based JAX-hazard linter
@@ -31,6 +33,20 @@ if [[ "${1:-}" == "metrics" || "${1:-}" == "trace" ]]; then
   root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
   export PYTHONPATH="$root${PYTHONPATH:+:$PYTHONPATH}"
   exec python -m bigdl_tpu.telemetry "$sub" "$@"
+fi
+
+# --- resilience subcommands (docs/RESILIENCE.md): snapshot audits and
+#     deterministic fault injection against checkpoint directories.
+#       ./scripts/bigdl-tpu.sh chaos corrupt /ckpt/model.40 --mode flip
+#       ./scripts/bigdl-tpu.sh resilience validate /ckpt
+if [[ "${1:-}" == "chaos" || "${1:-}" == "resilience" ]]; then
+  sub="$1"; shift
+  root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+  export PYTHONPATH="$root${PYTHONPATH:+:$PYTHONPATH}"
+  if [[ "$sub" == "chaos" ]]; then
+    exec python -m bigdl_tpu.resilience chaos "$@"
+  fi
+  exec python -m bigdl_tpu.resilience "$@"
 fi
 
 # --- compilation cache: first compile of a big model is 20-40s; persist it

@@ -1,0 +1,127 @@
+"""Worker for the multi-process preemption tests (not a pytest file).
+
+Usage: multihost_preempt_worker.py <phase> <tag> <pid> <nproc> <port>
+                                   <outdir> <ckptdir> <devs>
+
+Phase ``ref``: train 3 epochs uninterrupted; process 0 saves the final
+parameters as ``params_<tag>.npz``. Phase ``preempt``: install the
+preemption handler, write a ``step6.<pid>`` sentinel when step 6
+completes (then stretch every subsequent boundary by 0.25s so the parent's
+SIGTERM lands mid-training), snapshot + exit on ``TrainingPreempted`` and
+write ``preempted.<pid>``. Phase ``resume``: auto-resume from the newest
+complete snapshot under <ckptdir> and finish; process 0 saves
+``params_<tag>.npz``. The resume phase may run with a DIFFERENT process
+count than the save (elastic 2->1: total device count preserved, so the
+4-device mesh and its collective math are unchanged).
+
+The dataset hands each process a contiguous row slice of fixed global
+batches (no shuffling), so the assembled global batch is identical for
+every process layout — what lets the same-shape resume assert bit-exact
+parameters and the elastic resume assert tight allclose.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    (phase, tag, pid, nproc, port, outdir, ckptdir, devs) = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+        sys.argv[5], sys.argv[6], sys.argv[7], int(sys.argv[8]))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devs}")
+    if nproc > 1:
+        os.environ["BIGDL_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        os.environ["BIGDL_NUM_PROCESSES"] = str(nproc)
+        os.environ["BIGDL_PROCESS_ID"] = str(pid)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.base import MiniBatch
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.parallel.mesh import MeshTopology
+    from bigdl_tpu.resilience import PreemptionHandler, TrainingPreempted
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.rng import manual_seed
+
+    Engine.init()
+    assert Engine.process_count() == nproc, Engine.process_count()
+
+    # 8 fixed global batches of 16 records; this process serves rows
+    # [pid*16/nproc, (pid+1)*16/nproc) of each — contiguous slices, so
+    # make_array_from_process_local_data assembles the SAME global batch
+    # under any process count
+    data_rng = np.random.RandomState(0)
+    xs = data_rng.randn(8, 16, 6).astype(np.float32)
+    ys = data_rng.randint(1, 4, (8, 16)).astype(np.float32)
+    rows = 16 // nproc
+    lo, hi = pid * rows, (pid + 1) * rows
+
+    class FixedDistSet:
+        def data(self, train):
+            for x, y in zip(xs, ys):
+                yield MiniBatch(x[lo:hi], y[lo:hi])
+
+        def size(self):
+            return xs.shape[0] * xs.shape[1]
+
+        def shuffle(self):
+            pass
+
+        def is_distributed(self):
+            return True
+
+    manual_seed(42)
+    model = (nn.Sequential().add(nn.Linear(6, 16)).add(nn.Tanh())
+             .add(nn.Dropout(0.3))  # per-step keys must survive the resume
+             .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+    opt = Optimizer(model, FixedDistSet(), nn.ClassNLLCriterion(),
+                    topology=MeshTopology(data=jax.device_count()))
+    opt.set_optim_method(SGD(learningrate=0.05, momentum=0.9))
+    opt.set_end_when(Trigger.max_epoch(3))
+    opt.set_checkpoint(ckptdir, Trigger.every_epoch(), sharded=True)
+
+    if phase == "preempt":
+        opt.set_preemption_handler(PreemptionHandler())
+
+        class Sentinel:
+            fired = False
+
+            def on_step(self, neval):
+                if neval >= 6:
+                    if not self.fired:
+                        self.fired = True
+                        with open(os.path.join(outdir, f"step6.{pid}"),
+                                  "w") as f:
+                            f.write("x")
+                    time.sleep(0.25)  # widen the parent's SIGTERM window
+
+        opt.set_chaos([Sentinel()])
+        try:
+            opt.optimize()
+            print(f"worker {pid}: finished WITHOUT preemption", flush=True)
+        except TrainingPreempted as e:
+            with open(os.path.join(outdir, f"preempted.{pid}"), "w") as f:
+                f.write(str(e))
+            print(f"worker {pid}: preempted ({e})", flush=True)
+        return
+
+    if phase == "resume":
+        opt.auto_resume()
+    trained = opt.optimize()
+    if jax.process_index() == 0:
+        leaves = jax.tree_util.tree_leaves(trained.parameter_tree())
+        np.savez(os.path.join(outdir, f"params_{tag}.npz"),
+                 *[np.asarray(x) for x in leaves])
+    print(f"worker {pid}: {phase} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -187,6 +187,39 @@ class TestPerfHarness:
         transformer.train(["-b", "8", "--seqLen", "32", "-e", "1",
                            "--synthetic-size", "16", "--moeExperts", "4"])
 
+    def test_transformer_context_parallel_resume(self, tmp_path):
+        """--contextParallel now composes with --model/--state: the cp
+        loop writes (model.N, state.N) pairs through the resilience
+        coordinator, and a resume continues epoch/neval counters from the
+        saved driver instead of raising (ISSUE: transformer.py:150)."""
+        pytest.importorskip("jax").__version__
+        try:
+            from jax import shard_map  # noqa: F401 — cp loop needs it
+        except ImportError:
+            pytest.skip("jax.shard_map unavailable on this toolchain")
+        from bigdl_tpu.apps import transformer
+        from bigdl_tpu.resilience import coordinator
+        ck = str(tmp_path / "ck")
+        transformer.train(["-b", "8", "--seqLen", "32", "-e", "1",
+                           "--synthetic-size", "16", "--numHeads", "4",
+                           "--contextParallel", "ring",
+                           "--checkpoint", ck])
+        point = coordinator.latest_resume_point(ck)
+        assert point is not None  # cadence pair + marker written
+        assert point.marker["mesh"]["sync_mode"] == "context-parallel"
+        # resume for a second epoch from the pair (also covers the
+        # cp-format {"embed","tail"} param split restore)
+        transformer.train(["-b", "8", "--seqLen", "32", "-e", "2",
+                           "--synthetic-size", "16", "--numHeads", "4",
+                           "--contextParallel", "ring",
+                           "--model", point.model_path,
+                           "--state", point.state_path])
+        # and --autoResume discovers the pair without explicit paths
+        transformer.train(["-b", "8", "--seqLen", "32", "-e", "2",
+                           "--synthetic-size", "16", "--numHeads", "4",
+                           "--contextParallel", "ring",
+                           "--checkpoint", ck, "--autoResume"])
+
     def test_transformer_text_lm_end_to_end(self, tmp_path, capsys):
         """--textFile: BPE-tokenize real text, train, generate TEXT back."""
         from bigdl_tpu.apps import transformer

@@ -127,6 +127,29 @@ class TestRetryFromCheckpoint:
         model_path, _ = opt._latest_checkpoint()
         assert model_path.endswith("model.12")
 
+    def test_retry_skips_partial_snapshot(self, tmp_path):
+        """The retry loop must not trust a half-written snapshot: with the
+        newest sharded checkpoint missing a manifest-listed shard file
+        (what a kill mid-save leaves), discovery falls back to the older
+        complete pair instead of crashing the retry on a corrupt load."""
+        from bigdl_tpu.resilience import coordinator, corrupt_snapshot
+        opt = Optimizer(_model(), _dataset(), nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(2),
+                           sharded=True)
+        opt.set_end_when(Trigger.max_epoch(2))
+        opt.optimize()
+        points = [p for p in [coordinator.latest_resume_point(str(tmp_path))]
+                  if p]
+        assert points, "no snapshots written"
+        newest = points[0].neval
+        corrupt_snapshot(points[0].model_path, mode="delete")
+        fallback = coordinator.latest_resume_point(str(tmp_path))
+        assert fallback is not None and fallback.neval < newest
+        model_path, state_path = opt._latest_checkpoint()
+        assert model_path == fallback.model_path
+        assert state_path == fallback.state_path
+
     def test_resume_continues_counting(self, tmp_path):
         # checkpoint at epoch boundary, then resume in a fresh optimizer:
         # epoch/neval continue rather than restart (reference §5.4)
@@ -142,3 +165,89 @@ class TestRetryFromCheckpoint:
         opt2.set_end_when(Trigger.max_epoch(4))
         trained = opt2.optimize()
         assert trained is not None
+
+
+class TestSnapshotAtomicity:
+    """Kill-during-save semantics (ISSUE 10 satellite): shard files and
+    the manifest land via tmp+rename, manifest last — a writer killed at
+    ANY point leaves either a missing manifest or a manifest naming a
+    missing shard, both rejected as partial; the previous snapshot stays
+    the resume point."""
+
+    def test_kill_during_save_leaves_nothing_under_final_names(
+            self, tmp_path, monkeypatch):
+        from bigdl_tpu.resilience import coordinator
+        from bigdl_tpu.utils import sharded_checkpoint as sckpt
+
+        def killed(*a, **k):
+            raise RuntimeError("writer killed mid-save")
+
+        monkeypatch.setattr(np, "savez", killed)
+        with pytest.raises(RuntimeError, match="killed mid-save"):
+            sckpt.save_sharded(str(tmp_path / "model.9"),
+                               {"w": np.arange(4, dtype=np.float32)})
+        monkeypatch.undo()
+        left = os.listdir(tmp_path / "model.9")
+        assert not any(f.endswith(".npz") for f in left), left
+        assert "manifest.json" not in left
+        assert not coordinator.sharded_snapshot_complete(
+            str(tmp_path / "model.9"))
+
+    def test_partial_snapshot_rejected_previous_used(self, tmp_path,
+                                                     monkeypatch):
+        """End-to-end through the optimizer: complete snapshot at neval 3,
+        then a later save dies mid-write — auto-resume must restart from
+        neval 3, not crash on the torn pair."""
+        from bigdl_tpu.resilience import coordinator
+        from bigdl_tpu.utils import sharded_checkpoint as sckpt
+        ds = _dataset()
+        opt = Optimizer(_model(), ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(2),
+                           sharded=True)
+        opt.set_end_when(Trigger.max_epoch(1))
+        opt.optimize()
+        good = coordinator.latest_resume_point(str(tmp_path))
+        assert good is not None
+
+        calls = {"n": 0}
+        orig = np.savez
+
+        def dies_on_second_dir(*a, **k):
+            calls["n"] += 1
+            if calls["n"] > 1:  # model dir written, state save killed
+                raise RuntimeError("writer killed mid-save")
+            return orig(*a, **k)
+
+        monkeypatch.setattr(np, "savez", dies_on_second_dir)
+        with pytest.raises(RuntimeError, match="killed mid-save"):
+            sckpt.save_sharded(str(tmp_path / f"model.{good.neval + 4}"),
+                               {"w": np.arange(4, dtype=np.float32)})
+            sckpt.save_sharded(str(tmp_path / f"state.{good.neval + 4}"),
+                               {"w": np.arange(4, dtype=np.float32)})
+        monkeypatch.undo()
+        point = coordinator.latest_resume_point(str(tmp_path))
+        assert point is not None and point.neval == good.neval
+
+
+class TestChaosDeterminism:
+    def test_kill_at_step_preempts_at_identical_step_twice(self, tmp_path):
+        """Two identical runs with the same kill-at-step injector snapshot
+        at the SAME step — the reproducibility contract that makes a
+        recovery test failing once fail every time."""
+        from bigdl_tpu.resilience import (KillAtStep, PreemptionHandler,
+                                          TrainingPreempted, coordinator)
+        steps = []
+        for attempt in range(2):
+            ckpt = tmp_path / f"run{attempt}"
+            opt = Optimizer(_model(), _dataset(), nn.ClassNLLCriterion())
+            opt.set_optim_method(SGD(learningrate=0.1))
+            opt.set_checkpoint(str(ckpt), Trigger.every_epoch())
+            opt.set_end_when(Trigger.max_epoch(3))
+            opt.set_preemption_handler(PreemptionHandler())
+            opt.set_chaos([KillAtStep(5)])
+            with pytest.raises(TrainingPreempted):
+                opt.optimize()
+            steps.append(coordinator.latest_resume_point(str(ckpt))
+                         .marker["step"])
+        assert steps == [6, 6]  # killed AT step 5, resume at 6 — both runs

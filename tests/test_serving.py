@@ -221,6 +221,64 @@ class TestContinuousQuantizedCompose:
             srv.close()
 
 
+class TestDeadServerState:
+    def test_step_failure_kills_server_and_fails_fast(self):
+        """A decode-step failure fails the in-flight request AND marks the
+        server dead: the NEXT submit raises immediately (no queueing
+        against a worker that will never serve it — ADVICE medium,
+        serving.py:302), and /health flunks via dead_reason."""
+        model = _mk_model()
+        srv = ContinuousLMServer(model, slots=2, max_len=32, greedy=True,
+                                 decode_block=4)
+        try:
+            # warm up a healthy request, then inject a step failure
+            assert len(srv.submit([3, 7, 2], 4, timeout=120)) == 4
+            def boom(*a, **k):
+                raise RuntimeError("injected step failure")
+            srv._step_fn = boom
+            with pytest.raises(RuntimeError, match="injected step failure"):
+                srv.submit([5, 1, 4], 8, timeout=120)
+            assert srv.dead_reason is not None
+            assert "injected step failure" in srv.dead_reason
+            # fail-fast: no timeout wait, the queue is never touched
+            t0 = time.perf_counter()
+            with pytest.raises(RuntimeError, match="server is dead"):
+                srv.submit([2, 2], 4, timeout=120)
+            assert time.perf_counter() - t0 < 1.0
+        finally:
+            srv.close()
+
+    def test_worker_loop_crash_marks_dead(self):
+        """A crash OUTSIDE the per-request/decode handlers (worker-loop
+        error) also lands in the dead state instead of silently killing
+        the thread and stranding clients on their timeouts."""
+        model = _mk_model()
+        srv = ContinuousLMServer(model, slots=1, max_len=32, greedy=True,
+                                 decode_block=4)
+        gauge = srv._tm.serving_queue_depth
+        orig = gauge.set
+        fired = {}
+
+        def boom(v):
+            if not fired:  # one-shot: _die's own gauge writes must pass
+                fired["x"] = True
+                raise RuntimeError("worker loop broke")
+            return orig(v)
+
+        try:
+            gauge.set = boom
+            deadline = time.time() + 10
+            while srv.dead_reason is None and time.time() < deadline:
+                time.sleep(0.02)
+            assert srv.dead_reason is not None
+            assert "worker loop broke" in srv.dead_reason
+            with pytest.raises(RuntimeError, match="server is dead"):
+                srv.submit([3, 1], 4, timeout=5)
+        finally:
+            gauge.set = orig
+            srv.close()
+
+
 class TestContinuousSampling:
     def test_sampled_mode_terminates_and_varies(self):
         """Temperature sampling through the slot engine: requests finish,

@@ -104,6 +104,69 @@ class TestLibraryRoundTrip:
         np.testing.assert_array_equal(out["a"], tree["a"])
         assert int(out["b"]) == 7
 
+    def test_stale_shard_from_wider_save_is_invisible(self, tmp_path):
+        """The ADVICE stale-shard overwrite hazard: a snapshot dir reused
+        by a save with FEWER processes must not resurrect slabs from the
+        earlier, wider save. Simulated by planting the wider run's extra
+        shard file (shard-00001.npz with stale values at the same
+        offsets), then re-saving with this 1-process run: the manifest
+        now names only shard-00000, process 0 deletes the foreign file,
+        and restore sees only fresh data."""
+        import shutil
+        ck = tmp_path / "ck"
+        stale = {"w": np.full((8, 4), 111.0, np.float32)}
+        save_sharded(str(ck), stale)
+        # the "second process" of an imaginary wider save left this behind
+        shutil.copy(ck / "shard-00000.npz", ck / "shard-00001.npz")
+        fresh = {"w": np.full((8, 4), 222.0, np.float32)}
+        save_sharded(str(ck), fresh)
+        assert not (ck / "shard-00001.npz").exists()  # stale file cleared
+        out = load_sharded(str(ck), {"w": None})
+        np.testing.assert_array_equal(out["w"], fresh["w"])
+
+    def test_manifest_names_shards_and_restricts_reads(self, tmp_path):
+        """Format-2 manifests pin the participating shard files; a
+        planted foreign shard-*.npz (even one that survives the stale
+        clear, e.g. copied in AFTER the save) is not read."""
+        import json
+        ck = tmp_path / "ck"
+        save_sharded(str(ck), {"w": np.arange(8, dtype=np.float32)})
+        with open(ck / "manifest.json") as f:
+            manifest = json.load(f)
+        assert manifest["format"] == 2
+        assert manifest["shards"] == ["shard-00000.npz"]
+        # plant a stale shard after the save: same member names, wrong data
+        import shutil
+        shutil.copy(ck / "shard-00000.npz", ck / "shard-00099.npz")
+        with open(ck / "shard-00000.npz", "rb") as f:
+            good = f.read()
+        out = load_sharded(str(ck), {"w": None})
+        np.testing.assert_array_equal(out["w"],
+                                      np.arange(8, dtype=np.float32))
+        with open(ck / "shard-00000.npz", "rb") as f:
+            assert f.read() == good  # untouched
+
+    def test_missing_manifest_shard_raises(self, tmp_path):
+        ck = tmp_path / "ck"
+        save_sharded(str(ck), {"w": np.arange(8, dtype=np.float32)})
+        os.unlink(ck / "shard-00000.npz")
+        with pytest.raises(ValueError, match="incomplete"):
+            load_sharded(str(ck), {"w": None})
+
+    def test_format1_manifest_still_loads(self, tmp_path):
+        """Back-compat: a pre-fix snapshot (bare leaves-dict manifest, no
+        shard list) restores via the glob path."""
+        import json
+        ck = tmp_path / "ck"
+        save_sharded(str(ck), {"w": np.arange(8, dtype=np.float32)})
+        with open(ck / "manifest.json") as f:
+            manifest = json.load(f)
+        with open(ck / "manifest.json", "w") as f:
+            json.dump(manifest["leaves"], f)  # rewrite as format 1
+        out = load_sharded(str(ck), {"w": None})
+        np.testing.assert_array_equal(out["w"],
+                                      np.arange(8, dtype=np.float32))
+
 
 def _fixed_batches(n_batches=4, batch=32, dim=6, classes=3, seed=0):
     rng = np.random.RandomState(seed)
