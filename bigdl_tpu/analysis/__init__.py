@@ -26,9 +26,11 @@ from bigdl_tpu.analysis.core import (Finding, FileResult, Rule, RULES,
                                      all_rules, lint_file, lint_paths,
                                      lint_source, register, render_json,
                                      render_text, select_rules)
+from bigdl_tpu.analysis.program import ProgramIndex
+from bigdl_tpu.analysis.sarif import render_sarif, sarif_report
 
 __all__ = [
-    "Finding", "FileResult", "Rule", "RULES", "all_rules", "lint_file",
-    "lint_paths", "lint_source", "register", "render_json", "render_text",
-    "select_rules",
+    "Finding", "FileResult", "ProgramIndex", "Rule", "RULES", "all_rules",
+    "lint_file", "lint_paths", "lint_source", "register", "render_json",
+    "render_sarif", "render_text", "sarif_report", "select_rules",
 ]

@@ -2,18 +2,23 @@
 
 Exit status 0 when every finding is suppressed (with a reason), 1 when
 unsuppressed findings remain, 2 on usage errors — so the command slots
-straight into CI and ``scripts/bigdl-tpu.sh lint``.
+straight into CI and ``scripts/bigdl-tpu.sh lint``. ``--changed REF``
+narrows the pass to files changed vs a git ref (fast local gating);
+``--sarif PATH`` writes a SARIF 2.1.0 report alongside the stdout
+format.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 from typing import List, Optional
 
-from bigdl_tpu.analysis.core import (all_rules, lint_paths, render_json,
-                                     render_text)
+from bigdl_tpu.analysis.core import (all_rules, iter_python_files,
+                                     lint_paths, render_json, render_text)
+from bigdl_tpu.analysis.sarif import render_sarif
 
 
 def _csv(value: str) -> List[str]:
@@ -38,6 +43,36 @@ def rule_table() -> str:
     return "\n".join(lines)
 
 
+def changed_files(ref: str, paths: List[str]) -> List[str]:
+    """``.py`` files under ``paths`` that differ from git ``ref``
+    (deleted files excluded), PLUS untracked files — a brand-new module
+    is the one most likely to hold fresh findings, and ``git diff``
+    alone never lists it. Raises ValueError when git can't answer — the
+    caller turns that into a usage error, never a silent pass."""
+    probe = paths[0] if paths else os.getcwd()
+    probe_dir = probe if os.path.isdir(probe) else os.path.dirname(probe)
+    try:
+        top = subprocess.run(
+            ["git", "-C", probe_dir or ".", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True).stdout.strip()
+        out = subprocess.run(
+            ["git", "-C", top, "diff", "--name-only", "--diff-filter=d",
+             ref, "--", "*.py"],
+            capture_output=True, text=True, check=True).stdout
+        untracked = subprocess.run(
+            ["git", "-C", top, "ls-files", "--others",
+             "--exclude-standard", "--", "*.py"],
+            capture_output=True, text=True, check=True).stdout
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        raise ValueError(f"--changed {ref}: {detail.strip()}")
+    changed = {os.path.abspath(os.path.join(top, line))
+               for line in (out.splitlines() + untracked.splitlines())
+               if line.strip()}
+    lint_set = {os.path.abspath(p) for p in iter_python_files(paths)}
+    return sorted(changed & lint_set)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m bigdl_tpu.analysis",
@@ -49,8 +84,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="comma-separated rule codes to run (only)")
     parser.add_argument("--ignore", type=_csv, default=None, metavar="CODES",
                         help="comma-separated rule codes to skip")
-    parser.add_argument("--format", choices=("text", "json"), default="text",
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text",
                         help="report format (default: text)")
+    parser.add_argument("--sarif", metavar="PATH", default=None,
+                        help="also write a SARIF 2.1.0 report to PATH")
+    parser.add_argument("--changed", metavar="REF", default=None,
+                        help="lint only files changed vs this git ref "
+                             "(whole-program facts come from the changed "
+                             "set only — run the full gate before merging)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule table and exit")
     args = parser.parse_args(argv)
@@ -65,18 +107,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"graftlint: no such path(s): {', '.join(missing)}",
               file=sys.stderr)
         return 2
+    files = None
     try:
+        if args.changed is not None:
+            files = changed_files(args.changed, paths)
+            if not files:
+                # stderr: stdout must stay a clean json/sarif document
+                print(f"graftlint: no linted files changed vs "
+                      f"{args.changed}", file=sys.stderr)
         # lint_paths validates --select/--ignore codes via select_rules
-        results = lint_paths(paths, select=args.select, ignore=args.ignore)
+        results = lint_paths(paths, select=args.select, ignore=args.ignore,
+                             files=files)
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
     except OSError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
-    out = (render_json(results) if args.format == "json"
-           else render_text(results))
+    if args.format == "json":
+        out = render_json(results)
+    elif args.format == "sarif":
+        out = render_sarif(results)
+    else:
+        out = render_text(results)
     print(out)
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            f.write(render_sarif(results))
+        print(f"graftlint: SARIF report written to {args.sarif}",
+              file=sys.stderr)
     return 1 if any(res.findings for res in results) else 0
 
 

@@ -32,7 +32,12 @@ Three layers live here:
   reason is mandatory: a bare ignore does not suppress and is itself
   reported (JG000, unsuppressable).
 
-See ``docs/ANALYSIS.md`` for the rule catalogue and how to add a rule.
+Since the v2 whole-program grow-out, ``lint_paths`` additionally builds
+a :class:`~bigdl_tpu.analysis.program.ProgramIndex` over every linted
+file: jitted-context, tracer-taint, and PRNG-stream facts propagate
+through helper calls *across modules*, and the sharding/compile-cache/
+concurrency rule families (JG010–JG017) consume its call graph. See
+``docs/ANALYSIS.md`` for the rule catalogue and how to add a rule.
 """
 
 from __future__ import annotations
@@ -299,6 +304,19 @@ class JitIndex:
         self._seed(tree)
         self._propagate()
 
+    def add_extern_compiled(self, fn_nodes: Iterable[ast.AST]) -> None:
+        """Mark functions compiled from ANOTHER module's trace (whole-
+        program propagation) and re-close the local compiled set. Extern
+        functions are never seeds: like locally propagated helpers, their
+        parameters are not assumed traced."""
+        added = False
+        for fn in fn_nodes:
+            if fn not in self.compiled:
+                self.compiled.add(fn)
+                added = True
+        if added:
+            self._propagate()
+
     # -- construction ------------------------------------------------------
     def _index(self, tree: ast.Module) -> None:
         for node in ast.walk(tree):
@@ -447,18 +465,38 @@ class JitIndex:
             node = self.parent.get(node)
         return ".".join(reversed(parts))
 
+    def enclosing_class_name(self, fn: ast.AST) -> Optional[str]:
+        """Name of the nearest enclosing class (``self.m()`` resolution
+        for cross-module summaries), or None."""
+        node = self.parent.get(fn)
+        while node is not None:
+            if isinstance(node, ast.ClassDef):
+                return node.name
+            node = self.parent.get(node)
+        return None
+
 
 def iter_own_statements(fn: ast.AST) -> Iterator[ast.AST]:
     """Walk a function's nodes WITHOUT entering nested def/lambda bodies
-    (nested functions are analyzed on their own)."""
+    (nested functions are analyzed on their own). The nested def node
+    itself IS yielded — only its body is private to it. (Before v2 a def
+    that was a *direct statement* leaked its body into the walk, which
+    made helpers that build-and-return nested jit factories look like
+    jit factories themselves.)"""
     stack = list(getattr(fn, "body", []))
     while stack:
         node = stack.pop()
         yield node
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (*_FUNC_TYPES, ast.Lambda)):
-                continue
-            stack.append(child)
+        if isinstance(node, (*_FUNC_TYPES, ast.Lambda)):
+            # the body is private to the nested function, but its
+            # decorators and parameter defaults EXECUTE in the enclosing
+            # scope — keep them visible to the walk
+            stack.extend(getattr(node, "decorator_list", ()))
+            args = node.args
+            stack.extend(args.defaults)
+            stack.extend(d for d in args.kw_defaults if d is not None)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
 
 
 # --------------------------------------------------------------------------
@@ -485,6 +523,9 @@ _STATIC_JAX_CALLS = {
     "jax.default_backend", "jax.devices", "jax.local_devices",
     "jax.eval_shape", "jax.ShapeDtypeStruct",
     "jax.tree_util.tree_structure", "jnp.ndim", "jnp.shape",
+    # dtype-metadata predicates: static Python values even under trace
+    "jnp.issubdtype", "jnp.result_type", "jnp.promote_types",
+    "jnp.finfo", "jnp.iinfo", "jnp.dtype", "jnp.isdtype",
 }
 
 
@@ -511,10 +552,13 @@ class _TaintWalker:
     """
 
     def __init__(self, index: JitIndex, events: List[TraceEvent],
-                 src: Optional[str] = None):
+                 src: Optional[str] = None, program=None,
+                 module: Optional[str] = None):
         self.index = index
         self.events = events
         self.src = src
+        self.program = program       # ProgramIndex (cross-module syncs)
+        self.module = module
 
     # -- entry -------------------------------------------------------------
     def run(self, fn: ast.AST, inherited: Optional[Set[str]] = None) -> None:
@@ -544,7 +588,8 @@ class _TaintWalker:
             self._stmt(stmt, tainted)
 
     def _nested(self, fn: ast.AST, tainted: Set[str]) -> None:
-        sub = _TaintWalker(self.index, self.events, self.src)
+        sub = _TaintWalker(self.index, self.events, self.src,
+                           self.program, self.module)
         sub.run(fn, inherited=set(tainted))
 
     def _stmt(self, stmt: ast.stmt, tainted: Set[str]) -> None:
@@ -738,9 +783,11 @@ class _TaintWalker:
                     root = root.value
                 recv_taint = (isinstance(root, ast.Name)
                               and root.id in tainted)
-        arg_taint = self._expr_list(node.args, tainted)
-        kw_taint = self._expr_list((kw.value for kw in node.keywords),
-                                   tainted)
+        arg_taints = [self._expr(a, tainted) for a in node.args]
+        kw_taints = {kw.arg: self._expr(kw.value, tainted)
+                     for kw in node.keywords}
+        arg_taint = any(arg_taints)
+        kw_taint = any(kw_taints.values())
         any_taint = arg_taint or kw_taint
 
         if callee in _HOST_CONVERTERS and any_taint:
@@ -754,6 +801,19 @@ class _TaintWalker:
                 "host_sync", node, f".{node.func.attr}()",
                 self.index.qualname(self._fn)))
             return False
+        if self.program is not None and callee and any_taint:
+            # whole-program: a traced value handed to a helper (possibly
+            # in another module) whose summary says that parameter is
+            # host-synced — the finding lands at the point of entry
+            hit = self.program.call_syncs_tainted(
+                self.module, callee, arg_taints, kw_taints,
+                self.index.enclosing_class_name(self._fn))
+            if hit is not None:
+                self.events.append(TraceEvent(
+                    "host_sync", node,
+                    f"{callee}() [{hit} host-syncs this argument]",
+                    self.index.qualname(self._fn)))
+                return False
         if callee in _STATIC_BUILTINS or callee in _STATIC_JAX_CALLS:
             return False
         if callee is not None and callee.startswith(_ARRAY_NAMESPACES):
@@ -765,7 +825,8 @@ def iter_trace_events(ctx: "FileContext") -> List[TraceEvent]:
     """All taint events for the file, computed once and cached on ctx."""
     if ctx._trace_events is None:
         events: List[TraceEvent] = []
-        walker = _TaintWalker(ctx.jit_index, events, ctx.source)
+        walker = _TaintWalker(ctx.jit_index, events, ctx.source,
+                              ctx.program, ctx.module)
         for fn in ctx.jit_index.taint_roots():
             walker.run(fn)
         ctx._trace_events = events
@@ -779,7 +840,10 @@ def iter_trace_events(ctx: "FileContext") -> List[TraceEvent]:
 
 @dataclass
 class FileContext:
-    """Everything a rule needs about one source file."""
+    """Everything a rule needs about one source file. ``module`` and
+    ``program`` are set when the file is linted as part of a whole-
+    program pass (``lint_paths``) — rules degrade to per-file behaviour
+    when ``program`` is None."""
 
     path: str
     source: str
@@ -787,8 +851,30 @@ class FileContext:
     jit_index: JitIndex
     suppressions: Dict[int, List[Suppression]]
     comment_only_lines: Set[int]
+    module: Optional[str] = None
+    program: Optional[object] = field(default=None, repr=False)
     _trace_events: Optional[List[TraceEvent]] = field(default=None,
                                                       repr=False)
+    _all_nodes: Optional[List[ast.AST]] = field(default=None, repr=False)
+    _rule_caches: Dict[str, object] = field(default_factory=dict,
+                                            repr=False)
+
+    def walk(self) -> List[ast.AST]:
+        """Every node of the tree, walked once and cached — rules that
+        scan the whole file iterate this instead of re-walking (the
+        16-rule pass re-walked the tree dozens of times per file and
+        blew the gate's time budget)."""
+        if self._all_nodes is None:
+            self._all_nodes = list(ast.walk(self.tree))
+        return self._all_nodes
+
+    def rule_cache(self, key: str, build):
+        """Get-or-build a per-file helper shared between rules (lock
+        index, mesh resolver, ...)."""
+        cached = self._rule_caches.get(key)
+        if cached is None:
+            cached = self._rule_caches[key] = build()
+        return cached
 
     @classmethod
     def parse(cls, path: str, source: Optional[str] = None) -> "FileContext":
@@ -819,16 +905,56 @@ class FileResult:
     suppressed: List[Finding]          # matched by a reasoned suppression
 
 
+def _syntax_error_result(path: str, e: SyntaxError) -> FileResult:
+    return FileResult(path, [Finding(
+        "JG000", f"syntax error prevents analysis: {e.msg}", path,
+        e.lineno or 1, (e.offset or 1) - 1)], [])
+
+
+def _wire_program(ctxs: Sequence[FileContext]) -> None:
+    """Build a ProgramIndex over the parsed contexts, attach it, and
+    inject cross-module compiled reach into each file's JitIndex."""
+    from bigdl_tpu.analysis.program import ProgramIndex
+    index = ProgramIndex.build([(ctx.path, ctx.tree) for ctx in ctxs])
+    per_file_compiled: Dict[str, List[ast.AST]] = {}
+    for ctx in ctxs:
+        rec = index.record_for(ctx.path)
+        if rec is None:  # pragma: no cover - every ctx was just indexed
+            continue
+        # the record's (possibly disambiguated) name, NOT a recomputed
+        # one — duplicate stems must resolve against their own file
+        ctx.module = rec.name
+        ctx.program = index
+        per_file_compiled[ctx.module] = [
+            fn for fn in ctx.jit_index.functions
+            if ctx.jit_index.is_compiled(fn)]
+    index.seed_compiled(per_file_compiled)
+    for ctx in ctxs:
+        rec = index.record_for(ctx.path)
+        if rec is None:
+            continue
+        names = index.extern_compiled_names(ctx.module)
+        ctx.jit_index.add_extern_compiled(
+            rec.functions[q] for q in names if q in rec.functions)
+
+
 def lint_source(path: str, source: str,
                 rules: Optional[Sequence[Rule]] = None) -> FileResult:
-    """Lint one in-memory source buffer (fixture tests use this)."""
+    """Lint one in-memory source buffer (fixture tests use this). The
+    buffer is its own one-module program, so same-module resolution
+    behaves identically to the whole-program pass."""
     rules = list(rules) if rules is not None else all_rules()
     try:
         ctx = FileContext.parse(path, source)
     except SyntaxError as e:
-        return FileResult(path, [Finding(
-            "JG000", f"syntax error prevents analysis: {e.msg}", path,
-            e.lineno or 1, (e.offset or 1) - 1)], [])
+        return _syntax_error_result(path, e)
+    _wire_program([ctx])
+    return _apply_rules(ctx, rules)
+
+
+def _apply_rules(ctx: FileContext, rules: Sequence[Rule]) -> FileResult:
+    """Run the rules over a prepared context and apply suppressions."""
+    path = ctx.path
     raw: List[Finding] = []
     for rule in rules:
         raw.extend(rule.check(ctx))
@@ -881,6 +1007,28 @@ def lint_file(path: str,
         return lint_source(path, f.read(), rules)
 
 
+def _lint_program(files: Sequence[str],
+                  rules: Sequence[Rule]) -> List[FileResult]:
+    """Whole-program pass: parse every file once, build the shared
+    ProgramIndex, then run the rules per file with cross-module facts
+    attached. Unparseable files report JG000 and stay out of the index."""
+    ctxs: List[FileContext] = []
+    results_by_path: Dict[str, FileResult] = {}
+    order: List[str] = []
+    for path in files:
+        order.append(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            ctxs.append(FileContext.parse(path, source))
+        except SyntaxError as e:
+            results_by_path[path] = _syntax_error_result(path, e)
+    _wire_program(ctxs)
+    for ctx in ctxs:
+        results_by_path[ctx.path] = _apply_rules(ctx, rules)
+    return [results_by_path[p] for p in order]
+
+
 def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
     for p in paths:
         if os.path.isfile(p):
@@ -919,11 +1067,17 @@ def select_rules(select: Optional[Iterable[str]] = None,
 
 def lint_paths(paths: Sequence[str],
                select: Optional[Iterable[str]] = None,
-               ignore: Optional[Iterable[str]] = None) -> List[FileResult]:
+               ignore: Optional[Iterable[str]] = None,
+               files: Optional[Sequence[str]] = None) -> List[FileResult]:
     """Lint every ``.py`` file under the given files/directories with the
-    selected rules; one FileResult per file, in walk order."""
+    selected rules as ONE whole program (cross-module facts propagate
+    between all of them); one FileResult per file, in walk order.
+    ``files`` overrides the walk with an explicit file list (the CLI's
+    ``--changed`` filter)."""
     rules = select_rules(select, ignore)
-    return [lint_file(p, rules) for p in iter_python_files(paths)]
+    if files is None:
+        files = list(iter_python_files(paths))
+    return _lint_program(files, rules)
 
 
 # --------------------------------------------------------------------------

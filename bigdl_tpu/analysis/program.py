@@ -1,0 +1,509 @@
+"""Whole-program index: imports, call graph, and cross-module fact
+propagation for graftlint.
+
+Per-file analysis (PR 3) stops at the module boundary: a ``jax.jit``-
+wrapped function that calls a host-syncing helper *in another file* is
+invisible, and the hazards that now matter — pspec/mesh-axis drift,
+compile storms behind helper indirection, races across the threaded
+serving/telemetry modules — are cross-cutting. :class:`ProgramIndex`
+parses every linted file once, resolves ``import``/``from-import``
+aliases to linted modules, and computes three whole-program fact sets by
+worklist fixpoint:
+
+- **externally-compiled functions** — the closure of "called (by a
+  resolvable name) from a compiled context in any module". Injected
+  into each file's :class:`~bigdl_tpu.analysis.core.JitIndex` so the
+  per-file rules (JG001/JG002/JG006...) see cross-module jit reach with
+  the same propagated-helper stance as local propagation (parameters
+  are NOT assumed traced; precision over recall).
+- **function summaries** — per top-level function/method:
+  ``sync_params`` (parameter positions whose traced value is forced to
+  the host, directly or through further calls), ``key_params``
+  (positions consumed as PRNG keys by ``jax.random`` draws), and
+  ``returns_jit`` (the function hands back a fresh ``jax.jit`` wrapper).
+  The taint engine and the PRNG/compile-cache rules consume these at
+  call sites, so the finding lands where the traced value *enters* the
+  helper — the line a reviewer can actually fix.
+- **loop reachability** — functions (transitively) called from inside a
+  Python loop anywhere in the program. JG014 uses this to flag jit-
+  cache growth in helpers that only *look* loop-free (the serving
+  prefill cache is filled from ``_run_loop``'s ``while`` via two call
+  hops).
+
+Everything stays pure ``ast``: modules are never imported, name
+resolution is static and gives up (returns ``None``) rather than guess.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from bigdl_tpu.analysis.core import (_FUNC_TYPES, _HOST_CONVERTERS,
+                                     _HOST_METHODS, _JIT_WRAPPERS,
+                                     dotted_name, iter_own_statements)
+
+# jax.random members that derive/construct keys rather than draw entropy
+# (kept in sync with rules/prng.py's _KEY_MAKERS)
+_KEY_MAKERS = {"PRNGKey", "key", "split", "fold_in", "wrap_key_data",
+               "key_data", "clone"}
+
+FuncKey = Tuple[str, str]  # (module dotted name, qualname within module)
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name for ``path``, walking up while ``__init__.py``
+    exists (``.../bigdl_tpu/models/serving.py`` ->
+    ``bigdl_tpu.models.serving``; a bare script keeps its stem)."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    if parts[0] == "__init__":
+        parts = parts[1:] or [os.path.basename(os.path.dirname(path))]
+    return ".".join(reversed(parts))
+
+
+@dataclass
+class FuncSummary:
+    """Cross-module facts about one function, propagated to fixpoint."""
+
+    sync_params: Set[int] = field(default_factory=set)
+    key_params: Set[int] = field(default_factory=set)
+    returns_jit: bool = False
+
+
+@dataclass
+class ModuleRecord:
+    """One parsed file: name resolution material for the index."""
+
+    name: str
+    path: str
+    tree: ast.Module
+    # import alias -> imported module dotted name (``import a.b as c``)
+    mod_aliases: Dict[str, str] = field(default_factory=dict)
+    # local name -> (module, symbol) for ``from a.b import f as g``
+    sym_imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    # qualname ("f" | "Cls.m") -> def node, top-level and class methods
+    functions: Dict[str, ast.AST] = field(default_factory=dict)
+    # module-level NAME = "literal" string constants (mesh-axis idiom)
+    str_constants: Dict[str, str] = field(default_factory=dict)
+
+    def qualname_of(self, node: ast.AST) -> Optional[str]:
+        for qual, fn in self.functions.items():
+            if fn is node:
+                return qual
+        return None
+
+
+def _positional_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in list(getattr(a, "posonlyargs", [])) + list(a.args)]
+
+
+def _index_module(name: str, path: str, tree: ast.Module) -> ModuleRecord:
+    rec = ModuleRecord(name, path, tree)
+    pkg = name.rsplit(".", 1)[0] if "." in name else ""
+    # imports anywhere in the file (this codebase lazy-imports jax-heavy
+    # modules inside functions; those aliases resolve the same way)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                rec.mod_aliases[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0])
+                if alias.asname is None:
+                    # ``import a.b.c`` binds ``a``; dotted uses are
+                    # resolved against the full path by the caller
+                    rec.mod_aliases[alias.name] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                # relative import: strip (level-1) package components
+                anchor = name.rsplit(".", node.level)[0] if \
+                    name.count(".") >= node.level else pkg
+                base = f"{anchor}.{base}" if base else anchor
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                rec.sym_imports[alias.asname or alias.name] = (base,
+                                                               alias.name)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if (isinstance(tgt, ast.Name) and isinstance(node.value,
+                                                         ast.Constant)
+                    and isinstance(node.value.value, str)):
+                rec.str_constants[tgt.id] = node.value.value
+    for node in tree.body:
+        if isinstance(node, _FUNC_TYPES):
+            rec.functions[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, _FUNC_TYPES):
+                    rec.functions[f"{node.name}.{sub.name}"] = sub
+    return rec
+
+
+class ProgramIndex:
+    """Cross-module resolution + propagated facts over a set of files."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleRecord] = {}
+        self._by_path: Dict[str, ModuleRecord] = {}
+        self.summaries: Dict[FuncKey, FuncSummary] = {}
+        self.extern_compiled: Set[FuncKey] = set()
+        self.loop_reachable: Set[FuncKey] = set()
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def build(cls, parsed: Sequence[Tuple[str, ast.Module]]
+              ) -> "ProgramIndex":
+        """Index ``(path, tree)`` pairs (each file parsed exactly once by
+        the caller) and run every propagation to fixpoint."""
+        idx = cls()
+        for path, tree in parsed:
+            name = module_name_for(path)
+            if name in idx.modules:
+                # two standalone scripts sharing a stem (dirA/util.py +
+                # dirB/util.py): disambiguate instead of clobbering, so
+                # each file's facts resolve against ITS OWN record (the
+                # suffixed name is unimportable, which is exactly right —
+                # nothing can resolve a call INTO it by name)
+                name = f"{name}@{len(idx.modules)}"
+            rec = _index_module(name, path, tree)
+            idx.modules[name] = rec
+            idx._by_path[os.path.abspath(path)] = rec
+        idx._compute_summaries()
+        idx._compute_loop_reachable()
+        return idx
+
+    def record_for(self, path: str) -> Optional[ModuleRecord]:
+        return self._by_path.get(os.path.abspath(path))
+
+    # -- name resolution ---------------------------------------------------
+    def resolve_call(self, module: str, callee: str,
+                     cls: Optional[str] = None) -> Optional[FuncKey]:
+        """Resolve a dotted callee seen in ``module`` to a linted
+        function: bare imported symbols, ``alias.func`` / full dotted
+        module paths, same-module functions, and ``self.m`` /``cls.m``
+        methods when ``cls`` (the enclosing class) is given."""
+        rec = self.modules.get(module)
+        if rec is None or not callee:
+            return None
+        if "." not in callee:
+            if callee in rec.sym_imports:
+                tmod, sym = rec.sym_imports[callee]
+                return self._lookup(tmod, sym)
+            if callee in rec.functions:
+                return (module, callee)
+            return None
+        head, rest = callee.split(".", 1)
+        if head in ("self", "cls") and cls is not None and "." not in rest:
+            if f"{cls}.{rest}" in rec.functions:
+                return (module, f"{cls}.{rest}")
+            return None
+        if head in rec.sym_imports and "." not in rest:
+            # ``from a import b`` then ``b.func()`` — b is a module
+            tmod, sym = rec.sym_imports[head]
+            return self._lookup(f"{tmod}.{sym}", rest)
+        if head in rec.mod_aliases:
+            target = rec.mod_aliases[head]
+            if "." in rest:
+                mod_part, fn_part = rest.rsplit(".", 1)
+                return self._lookup(f"{target}.{mod_part}", fn_part)
+            return self._lookup(target, rest)
+        # full dotted path (``import a.b.c`` style use)
+        mod_part, fn_part = callee.rsplit(".", 1)
+        return self._lookup(mod_part, fn_part)
+
+    def _lookup(self, module: str, func: str) -> Optional[FuncKey]:
+        rec = self.modules.get(module)
+        if rec is not None and func in rec.functions:
+            return (module, func)
+        return None
+
+    def resolve_str_constant(self, module: str, name: str) -> Optional[str]:
+        """``DATA_AXIS`` -> ``"data"``, following one from-import hop."""
+        rec = self.modules.get(module)
+        if rec is None:
+            return None
+        if name in rec.str_constants:
+            return rec.str_constants[name]
+        if name in rec.sym_imports:
+            tmod, sym = rec.sym_imports[name]
+            trec = self.modules.get(tmod)
+            if trec is not None:
+                return trec.str_constants.get(sym)
+        return None
+
+    def summary_for_call(self, module: str, callee: str,
+                         cls: Optional[str] = None
+                         ) -> Optional[Tuple[FuncKey, FuncSummary]]:
+        key = self.resolve_call(module, callee, cls)
+        if key is None:
+            return None
+        summ = self.summaries.get(key)
+        return (key, summ) if summ is not None else None
+
+    def _positions(self, key: FuncKey, callee: str,
+                   n_args: int) -> Tuple[List[int], Dict[str, int]]:
+        """Map a call's positional/keyword arguments to the target's
+        parameter indices (``self.m(...)`` shifts by the bound self)."""
+        fn = self._func_node(key)
+        params = _positional_names(fn)
+        skip = 1 if (callee.split(".", 1)[0] in ("self", "cls")
+                     and params[:1] == ["self"]) else 0
+        pos = [j + skip for j in range(n_args)]
+        kw = {name: i for i, name in enumerate(params)}
+        return pos, kw
+
+    def call_syncs_tainted(self, module: str, callee: str,
+                           arg_taints: Sequence[bool],
+                           kw_taints: Dict[Optional[str], bool],
+                           cls: Optional[str] = None) -> Optional[str]:
+        """Does this call hand a TRACED argument to a parameter the
+        target (possibly in another module) host-syncs? Returns the
+        qualified target name when so, else None."""
+        resolved = self.summary_for_call(module, callee, cls)
+        if resolved is None or not resolved[1].sync_params:
+            return None
+        key, summ = resolved
+        pos, kw_index = self._positions(key, callee, len(arg_taints))
+        for j, tainted in enumerate(arg_taints):
+            if tainted and pos[j] in summ.sync_params:
+                return f"{key[0]}.{key[1]}"
+        for name, tainted in kw_taints.items():
+            if tainted and name is not None \
+                    and kw_index.get(name) in summ.sync_params:
+                return f"{key[0]}.{key[1]}"
+        return None
+
+    def call_consumes_key(self, module: str, callee: str, arg_pos: int,
+                          kw_name: Optional[str],
+                          cls: Optional[str] = None) -> bool:
+        """Does the argument at ``arg_pos`` (or keyword ``kw_name``) of
+        this call land on a parameter the target draws PRNG entropy
+        from? (JG003 cross-module consumption.)"""
+        resolved = self.summary_for_call(module, callee, cls)
+        if resolved is None or not resolved[1].key_params:
+            return False
+        key, summ = resolved
+        if kw_name is not None:
+            _, kw_index = self._positions(key, callee, 0)
+            return kw_index.get(kw_name) in summ.key_params
+        pos, _ = self._positions(key, callee, arg_pos + 1)
+        return pos[arg_pos] in summ.key_params
+
+    # -- summaries ---------------------------------------------------------
+    def _func_node(self, key: FuncKey) -> Optional[ast.AST]:
+        rec = self.modules.get(key[0])
+        return rec.functions.get(key[1]) if rec else None
+
+    def _enclosing_class(self, rec: ModuleRecord, qual: str) -> Optional[str]:
+        return qual.split(".", 1)[0] if "." in qual else None
+
+    def _compute_summaries(self) -> None:
+        keys = [(m, q) for m, rec in self.modules.items()
+                for q in rec.functions]
+        self.summaries = {k: FuncSummary() for k in keys}
+        for key in keys:
+            self._direct_summary(key)
+        # fixpoint: facts flow backwards through call argument positions
+        changed = True
+        rounds = 0
+        while changed and rounds < 20:
+            changed = False
+            rounds += 1
+            for key in keys:
+                if self._propagate_summary(key):
+                    changed = True
+
+    def _param_index(self, fn: ast.AST, name: str) -> Optional[int]:
+        try:
+            return _positional_names(fn).index(name)
+        except ValueError:
+            return None
+
+    def _direct_summary(self, key: FuncKey) -> None:
+        fn = self._func_node(key)
+        summ = self.summaries[key]
+        params = _positional_names(fn)
+        pset = set(params)
+        for node in iter_own_statements(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if self._is_jit_expr(node.value, fn):
+                    summ.returns_jit = True
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee in _HOST_CONVERTERS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in pset:
+                        i = self._param_index(fn, arg.id)
+                        if i is not None:
+                            summ.sync_params.add(i)
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _HOST_METHODS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in pset):
+                i = self._param_index(fn, node.func.value.id)
+                if i is not None:
+                    summ.sync_params.add(i)
+            if (callee and callee.startswith("jax.random.")
+                    and callee.rsplit(".", 1)[-1] not in _KEY_MAKERS):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in pset:
+                        i = self._param_index(fn, arg.id)
+                        if i is not None:
+                            summ.key_params.add(i)
+
+    def _is_jit_expr(self, expr: ast.expr, fn: ast.AST) -> bool:
+        """Value is a fresh jit wrapper: a direct ``jax.jit(...)`` call or
+        a local name bound to one anywhere in ``fn``."""
+        if isinstance(expr, ast.Call):
+            return dotted_name(expr.func) in _JIT_WRAPPERS
+        if isinstance(expr, ast.Name):
+            for node in iter_own_statements(fn):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and dotted_name(node.value.func) in _JIT_WRAPPERS):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name) and tgt.id == expr.id:
+                            return True
+        return False
+
+    def _propagate_summary(self, key: FuncKey) -> bool:
+        mod, qual = key
+        rec = self.modules[mod]
+        fn = rec.functions[qual]
+        summ = self.summaries[key]
+        cls = self._enclosing_class(rec, qual)
+        params = _positional_names(fn)
+        pset = set(params)
+        changed = False
+        for node in iter_own_statements(fn):
+            if isinstance(node, ast.Return) and node.value is not None \
+                    and not summ.returns_jit \
+                    and isinstance(node.value, ast.Call):
+                resolved = self.summary_for_call(
+                    mod, dotted_name(node.value.func) or "", cls)
+                if resolved is not None and resolved[1].returns_jit:
+                    summ.returns_jit = changed = True
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = self.summary_for_call(mod,
+                                             dotted_name(node.func) or "",
+                                             cls)
+            if resolved is None:
+                continue
+            tkey, tsumm = resolved
+            tfn = self._func_node(tkey)
+            if not (tsumm.sync_params or tsumm.key_params):
+                continue
+            skip_self = 1 if (isinstance(node.func, ast.Attribute)
+                              and isinstance(node.func.value, ast.Name)
+                              and node.func.value.id in ("self", "cls")
+                              and _positional_names(tfn)[:1] == ["self"]
+                              ) else 0
+            for j, arg in enumerate(node.args):
+                if not (isinstance(arg, ast.Name) and arg.id in pset):
+                    continue
+                i = self._param_index(fn, arg.id)
+                if i is None:
+                    continue
+                if j + skip_self in tsumm.sync_params \
+                        and i not in summ.sync_params:
+                    summ.sync_params.add(i)
+                    changed = True
+                if j + skip_self in tsumm.key_params \
+                        and i not in summ.key_params:
+                    summ.key_params.add(i)
+                    changed = True
+        return changed
+
+    # -- compiled-context propagation --------------------------------------
+    def seed_compiled(self, per_file_compiled: Dict[str, List[ast.AST]]
+                      ) -> None:
+        """Fixpoint the externally-compiled set from each module's locally
+        compiled functions (``per_file_compiled``: module name -> compiled
+        def nodes from its JitIndex)."""
+        work: List[Tuple[str, ast.AST]] = []
+        for mod, fns in per_file_compiled.items():
+            for fn in fns:
+                work.append((mod, fn))
+        seen_nodes: Set[int] = {id(fn) for _, fn in work}
+        while work:
+            mod, fn = work.pop()
+            rec = self.modules.get(mod)
+            if rec is None:
+                continue
+            qual = rec.qualname_of(fn)
+            cls = self._enclosing_class(rec, qual) if qual else None
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = self.resolve_call(mod, dotted_name(node.func) or "",
+                                           cls)
+                if target is None or target in self.extern_compiled:
+                    continue
+                tnode = self._func_node(target)
+                if tnode is None:
+                    continue
+                self.extern_compiled.add(target)
+                if id(tnode) not in seen_nodes:
+                    seen_nodes.add(id(tnode))
+                    work.append((target[0], tnode))
+
+    def extern_compiled_names(self, module: str) -> Set[str]:
+        """Qualnames in ``module`` compiled from another module's trace."""
+        return {q for m, q in self.extern_compiled if m == module}
+
+    # -- loop reachability --------------------------------------------------
+    def _compute_loop_reachable(self) -> None:
+        loops = (ast.For, ast.While, ast.ListComp, ast.SetComp, ast.DictComp,
+                 ast.GeneratorExp)
+        work: List[FuncKey] = []
+        for mod, rec in self.modules.items():
+            for qual, fn in rec.functions.items():
+                cls = self._enclosing_class(rec, qual)
+                for node in iter_own_statements(fn):
+                    if not isinstance(node, loops):
+                        continue
+                    for sub in ast.walk(node):
+                        if not isinstance(sub, ast.Call):
+                            continue
+                        target = self.resolve_call(
+                            mod, dotted_name(sub.func) or "", cls)
+                        if target is not None \
+                                and target not in self.loop_reachable:
+                            self.loop_reachable.add(target)
+                            work.append(target)
+        while work:
+            key = work.pop()
+            fn = self._func_node(key)
+            if fn is None:
+                continue
+            rec = self.modules[key[0]]
+            cls = self._enclosing_class(rec, key[1])
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = self.resolve_call(key[0],
+                                           dotted_name(node.func) or "", cls)
+                if target is not None and target not in self.loop_reachable:
+                    self.loop_reachable.add(target)
+                    work.append(target)
+
+    def called_from_loop(self, module: str, fn_node: ast.AST) -> bool:
+        rec = self.modules.get(module)
+        if rec is None:
+            return False
+        qual = rec.qualname_of(fn_node)
+        return qual is not None and (module, qual) in self.loop_reachable

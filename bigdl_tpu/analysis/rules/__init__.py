@@ -7,11 +7,14 @@ code; see ``docs/ANALYSIS.md`` for the walkthrough.
 """
 
 from bigdl_tpu.analysis.rules import (  # noqa: F401
+    compile_cache,
+    concurrency,
     donation,
     host_sync,
     jit_in_loop,
     mutable_defaults,
     prng,
+    sharding,
     side_effects,
     static_args,
     tracer_branch,

@@ -1,0 +1,192 @@
+"""JG013–JG014 — compile-cache hazards: traffic-dependent compile keys
+and unbounded jit-wrapper caches on loop-reachable paths.
+
+The serving compile storm is the motivating fixture: the continuous
+server's prefill compiles one XLA program per DISTINCT prompt length
+(``_prefill_fns[plen] = jax.jit(run)``), so arbitrary-length traffic
+from many users means arbitrary compiles and an ever-growing cache —
+invisible in tests that reuse three prompt lengths, catastrophic at pod
+scale. Both rules reason about *jit-wrapper values*: a direct
+``jax.jit(...)`` call, a local name bound to one, or a call to a
+function whose whole-program summary says it returns a fresh wrapper
+(``models/generation._build_decode_fn`` style builders).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from bigdl_tpu.analysis.core import (FileContext, Finding, Rule, _FUNC_TYPES,
+                                     _JIT_WRAPPERS, dotted_name,
+                                     iter_own_statements, register)
+
+_LOOPS = (ast.For, ast.While, ast.ListComp, ast.SetComp, ast.DictComp,
+          ast.GeneratorExp)
+_EVICTORS = {"pop", "popitem", "clear"}
+
+
+def _is_jit_call(expr: ast.expr, ctx: FileContext,
+                 cls: Optional[str]) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    callee = dotted_name(expr.func) or ""
+    if callee in _JIT_WRAPPERS:
+        return True
+    if ctx.program is not None and ctx.module is not None:
+        resolved = ctx.program.summary_for_call(ctx.module, callee, cls)
+        if resolved is not None and resolved[1].returns_jit:
+            return True
+    return False
+
+
+def _is_jit_value(expr: ast.expr, fn: ast.AST, ctx: FileContext,
+                  cls: Optional[str]) -> bool:
+    """``expr`` evaluates to a fresh jit wrapper: directly, or a local
+    name that is bound to one anywhere in ``fn``."""
+    if _is_jit_call(expr, ctx, cls):
+        return True
+    if isinstance(expr, ast.Name):
+        for node in iter_own_statements(fn):
+            if isinstance(node, ast.Assign) \
+                    and _is_jit_call(node.value, ctx, cls):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == expr.id:
+                        return True
+    return False
+
+
+def _container_of(store_target: ast.expr) -> Optional[Tuple[str, ast.expr]]:
+    """For ``X[k] = ...`` return (dotted base of X, key expr)."""
+    if isinstance(store_target, ast.Subscript):
+        base = dotted_name(store_target.value)
+        if base is not None:
+            return base, store_target.slice
+    return None
+
+
+def _cache_inserts(fn: ast.AST, ctx: FileContext, cls: Optional[str]
+                   ) -> Iterator[Tuple[ast.AST, str, Optional[ast.expr]]]:
+    """Jit-wrapper container inserts in ``fn``: ``(node, container
+    dotted base, key expr or None)`` for ``X[k] = jitfn``,
+    ``X.setdefault(k, jitfn)`` and ``X.append(jitfn)``."""
+    for node in iter_own_statements(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                info = _container_of(tgt)
+                if info and _is_jit_value(node.value, fn, ctx, cls):
+                    yield node, info[0], info[1]
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            base = dotted_name(node.func.value)
+            if base is None:
+                continue
+            if node.func.attr == "setdefault" and len(node.args) == 2 \
+                    and _is_jit_value(node.args[1], fn, ctx, cls):
+                yield node, base, node.args[0]
+            elif node.func.attr == "append" and len(node.args) == 1 \
+                    and _is_jit_value(node.args[0], fn, ctx, cls):
+                yield node, base, None
+
+
+def _module_functions(ctx: FileContext) -> Iterator[ast.AST]:
+    return iter(ctx.jit_index.functions)
+
+
+def _has_eviction(ctx: FileContext, base: str) -> bool:
+    """Any ``<base>.pop/popitem/clear(...)`` or ``del <base>[...]`` in the
+    module — the cache is deliberately bounded. (Evicted container names
+    are indexed once per file.)"""
+
+    def build() -> set:
+        out = set()
+        for node in ctx.walk():
+            if isinstance(node, ast.Call) and isinstance(node.func,
+                                                         ast.Attribute) \
+                    and node.func.attr in _EVICTORS:
+                b = dotted_name(node.func.value)
+                if b is not None:
+                    out.add(b.rsplit(".", 1)[-1])
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        b = dotted_name(tgt.value)
+                        if b is not None:
+                            out.add(b.rsplit(".", 1)[-1])
+        return out
+
+    evicted = ctx.rule_cache("compile_cache.evicted", build)
+    return base.rsplit(".", 1)[-1] in evicted
+
+
+def _in_loop(node: ast.AST, fn: ast.AST, ctx: FileContext) -> bool:
+    cur = ctx.jit_index.parent.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, _LOOPS):
+            return True
+        if isinstance(cur, (*_FUNC_TYPES, ast.Lambda)):
+            return False
+        cur = ctx.jit_index.parent.get(cur)
+    return False
+
+
+@register
+class DynamicCompileKeyRule(Rule):
+    """A jit wrapper stored into a container under a NON-CONSTANT key is
+    a compile family keyed by a runtime value — ``len(request)``, a
+    prompt length, a batch shape. Every distinct key value traces and
+    compiles a fresh XLA program (seconds each), so traffic chooses
+    your compile count: the continuous server's per-prompt-length
+    prefill is the canonical storm. Bucket the key to a bounded set
+    (powers of two), make the dimension a traced size, or document the
+    bound with a suppression.
+    """
+
+    code = "JG013"
+    summary = ("jit wrapper cached under a dynamic (traffic-dependent) "
+               "key — every distinct value compiles a fresh XLA program")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in _module_functions(ctx):
+            cls = ctx.jit_index.enclosing_class_name(fn)
+            for node, base, key in _cache_inserts(fn, ctx, cls):
+                if key is None or isinstance(key, ast.Constant):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"jit wrapper stored in '{base}' under a dynamic key "
+                    f"— each distinct key value compiles a fresh XLA "
+                    f"program; bucket the key to a bounded set (e.g. "
+                    f"powers of two) or bound the family")
+
+
+@register
+class UnboundedJitCacheRule(Rule):
+    """A container of jit wrappers that grows on a LOOP-REACHABLE path
+    (the insert sits in a loop, or in a function the whole-program call
+    graph reaches from one — serving's prefill cache is filled from the
+    worker ``while`` via two call hops) with no eviction anywhere in
+    the module retains every compiled program forever: unbounded host
+    memory and an unbounded XLA cache. Bound it the way
+    ``models/generation``'s speculative cache does — clear at a cap.
+    """
+
+    code = "JG014"
+    summary = ("jit-wrapper cache grows without eviction on a "
+               "loop-reachable path (unbounded programs retained)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in _module_functions(ctx):
+            cls = ctx.jit_index.enclosing_class_name(fn)
+            for node, base, _key in _cache_inserts(fn, ctx, cls):
+                reachable = _in_loop(node, fn, ctx) or (
+                    ctx.program is not None and ctx.module is not None
+                    and ctx.program.called_from_loop(ctx.module, fn))
+                if not reachable or _has_eviction(ctx, base):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"'{base}' accumulates jit wrappers on a "
+                    f"loop-reachable path and nothing in this module "
+                    f"evicts it — every compiled program stays resident; "
+                    f"clear it at a cap or key it to a bounded set")

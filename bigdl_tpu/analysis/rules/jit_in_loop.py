@@ -55,7 +55,7 @@ class JitInLoopRule(Rule):
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         seen = set()  # a call in nested loops reports once, not per loop
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, (ast.For, ast.While, *_COMPREHENSIONS)):
                 continue
             if isinstance(node, ast.While):

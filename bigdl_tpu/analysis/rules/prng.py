@@ -90,7 +90,7 @@ class KeyReuseRule(Rule):
         counter pair, silently correlating their streams. Keys derived
         per-call belong in ``fold_in(base_key, counter)`` (collision-free
         by construction)."""
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.Call) or not node.args:
                 continue
             name = dotted_name(node.func)
@@ -124,6 +124,7 @@ class KeyReuseRule(Rule):
             if _KEY_PARAM_RE.search(a.arg):
                 state.tracked.add(a.arg)
         self._qual = self._ctx.jit_index.qualname(fn)
+        self._cls = self._ctx.jit_index.enclosing_class_name(fn)
         self._block(fn.body, state)
 
     def _block(self, stmts: Sequence[ast.stmt], state: _State) -> bool:
@@ -248,11 +249,14 @@ class KeyReuseRule(Rule):
             looks_only = (name in _NON_CONSUMING
                           or (name is not None
                               and name.rsplit(".", 1)[-1] in _LOG_METHODS))
-            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            args = [(j, None, a) for j, a in enumerate(node.args)]
+            args += [(None, kw.arg, kw.value) for kw in node.keywords]
+            for pos, kw_name, arg in args:
                 if isinstance(arg, ast.Name) and arg.id in state.tracked:
                     if derives or looks_only:
                         continue
-                    if is_rand or arg.id in state.definite:
+                    if is_rand or arg.id in state.definite \
+                            or self._helper_draws(name, pos, kw_name):
                         self._consume(arg.id, node, state)
                 else:
                     self._expr(arg, state)
@@ -267,6 +271,18 @@ class KeyReuseRule(Rule):
             state.merge(s2)
             return
         self._expr_children(node, state)
+
+    def _helper_draws(self, callee, pos, kw_name) -> bool:
+        """Whole-program: the callee's summary says this argument
+        position is consumed by a jax.random draw inside it (a key
+        handed to such a helper twice IS reuse, even across modules)."""
+        ctx = self._ctx
+        if callee is None or ctx.program is None or ctx.module is None \
+                or pos is None and kw_name is None:
+            return False
+        return ctx.program.call_consumes_key(
+            ctx.module, callee, pos if pos is not None else 0, kw_name,
+            self._cls)
 
     @staticmethod
     def _replace(state: _State, other: _State) -> None:

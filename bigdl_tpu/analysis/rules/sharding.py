@@ -1,0 +1,392 @@
+"""JG010–JG012 — sharding contracts: PartitionSpec/shard_map axis names
+vs the mesh declared at the call site, in_specs arity vs the wrapped
+function's signature, and collectives naming axes the enclosing mesh
+does not have.
+
+All three rules only fire when the mesh's axis names RESOLVE statically
+(a ``Mesh(..., ("data",))`` literal, a ``MeshTopology(...)`` build with
+literal sizes, or a local/module name bound to one). A mesh arriving as
+a parameter or attribute is unresolvable and the site is skipped —
+precision over recall, same stance as the rest of graftlint. Validated
+against the dryrun composition matrix (``__graft_entry__`` +
+``tests/test_comm_contract.py``): every real composition mode lints
+clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from bigdl_tpu.analysis.core import (FileContext, Finding, Rule, _FUNC_TYPES,
+                                     dotted_name, register)
+
+_SHARD_MAP = {"shard_map", "jax.shard_map",
+              "jax.experimental.shard_map.shard_map"}
+_PSPEC_LASTS = {"P", "PartitionSpec"}
+# collective -> index of its axis-name positional argument
+_COLLECTIVE_AXIS_POS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
+    "pbroadcast": 1, "axis_index": 0, "axis_size": 0,
+}
+_COLLECTIVE_PREFIXES = ("lax.", "jax.lax.")
+# MeshTopology signature order and kwarg->axis-name mapping (must match
+# bigdl_tpu/parallel/mesh.py: canonical order data, pipe, expert, seq,
+# tensor; size-1 axes dropped; all-1 falls back to ("data",))
+_TOPO_PARAMS = ("data", "tensor", "pipeline", "sequence", "expert")
+_TOPO_AXIS = {"data": "data", "tensor": "tensor", "pipeline": "pipe",
+              "sequence": "seq", "expert": "expert"}
+_TOPO_CANON = ("data", "pipeline", "expert", "sequence", "tensor")
+
+
+def _literal_axes(node: ast.expr) -> Optional[Tuple[str, ...]]:
+    """``("data", "tensor")`` / ``"data"`` literals -> axis tuple."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _topology_axes(call: ast.Call) -> Optional[Tuple[str, ...]]:
+    """Axes of ``MeshTopology(data=2, ...)`` with literal int sizes."""
+    sizes: Dict[str, int] = {k: 1 for k in _TOPO_PARAMS}
+    for i, arg in enumerate(call.args):
+        if i >= len(_TOPO_PARAMS) or not (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, int)):
+            return None
+        sizes[_TOPO_PARAMS[i]] = arg.value
+    for kw in call.keywords:
+        if kw.arg == "devices":
+            continue
+        if kw.arg not in sizes or not (
+                isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, int)):
+            return None
+        sizes[kw.arg] = kw.value.value
+    axes = tuple(_TOPO_AXIS[k] for k in _TOPO_CANON if sizes[k] > 1)
+    return axes or ("data",)
+
+
+class _MeshResolver:
+    """Static mesh-axes resolution with lexical-scope-aware name lookup."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.idx = ctx.jit_index
+        # name -> [(assign node, value expr)] over the whole module
+        self.assigns: Dict[str, List[Tuple[ast.AST, ast.expr]]] = {}
+        for node in ctx.walk():
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                self.assigns.setdefault(node.targets[0].id, []).append(
+                    (node, node.value))
+
+    def _scope_of(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.idx.parent.get(node)
+        while cur is not None and not isinstance(cur, _FUNC_TYPES):
+            cur = self.idx.parent.get(cur)
+        return cur
+
+    def axes_of(self, expr: ast.expr, at: ast.AST,
+                depth: int = 0) -> Optional[Tuple[str, ...]]:
+        if depth > 4:
+            return None
+        if isinstance(expr, ast.Call):
+            callee = dotted_name(expr.func) or ""
+            last = callee.rsplit(".", 1)[-1]
+            if last == "Mesh":
+                axes_arg = None
+                if len(expr.args) >= 2:
+                    axes_arg = expr.args[1]
+                for kw in expr.keywords:
+                    if kw.arg == "axis_names":
+                        axes_arg = kw.value
+                return _literal_axes(axes_arg) if axes_arg is not None \
+                    else None
+            if last == "build" and isinstance(expr.func, ast.Attribute):
+                return self._topology_of(expr.func.value, at, depth)
+            return None
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(
+                expr.id, at, depth,
+                lambda value, site: self.axes_of(value, site, depth + 1))
+        return None
+
+    def _topology_of(self, expr: ast.expr, at: ast.AST,
+                     depth: int) -> Optional[Tuple[str, ...]]:
+        """Axes of the MeshTopology value ``expr`` evaluates to."""
+        if depth > 4:
+            return None
+        if isinstance(expr, ast.Call):
+            callee = dotted_name(expr.func) or ""
+            if callee.rsplit(".", 1)[-1] == "MeshTopology":
+                return _topology_axes(expr)
+            if callee.endswith("data_parallel"):
+                return ("data",)
+            return None
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(
+                expr.id, at, depth,
+                lambda value, site: self._topology_of(value, site,
+                                                      depth + 1))
+        return None
+
+    def _resolve_name(self, name: str, at: ast.AST, depth: int,
+                      recurse) -> Optional[Tuple[str, ...]]:
+        """All visible assignments must resolve to the SAME axes."""
+        cands = self.assigns.get(name, [])
+        scope = self._scope_of(at)
+        visible = [(n, v) for n, v in cands
+                   if self._scope_of(n) is scope or self._scope_of(n) is None]
+        if not visible:
+            return None
+        resolved: Set[Tuple[str, ...]] = set()
+        for node, value in visible:
+            axes = recurse(value, node)
+            if axes is None:
+                return None
+            resolved.add(axes)
+        return resolved.pop() if len(resolved) == 1 else None
+
+
+def _axis_name_of(node: ast.expr, ctx: FileContext) -> Optional[str]:
+    """A single axis-name expression -> string, via literals and
+    (cross-module) module-level string constants."""
+    if isinstance(node, ast.Constant):
+        return node.value if isinstance(node.value, str) else None
+    if isinstance(node, ast.Name) and ctx.program is not None \
+            and ctx.module is not None:
+        return ctx.program.resolve_str_constant(ctx.module, node.id)
+    return None
+
+
+def _spec_axes(expr: ast.expr, ctx: FileContext
+               ) -> Iterator[Tuple[str, ast.AST]]:
+    """Every axis name used in P(...)/PartitionSpec(...) calls under
+    ``expr`` (tuple entries of one spec dimension included)."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func) or ""
+        if callee.rsplit(".", 1)[-1] not in _PSPEC_LASTS:
+            continue
+        for arg in node.args:
+            elts = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) \
+                else [arg]
+            for elt in elts:
+                if isinstance(elt, ast.Constant) and elt.value is None:
+                    continue
+                axis = _axis_name_of(elt, ctx)
+                if axis is not None:
+                    yield axis, node
+
+
+def _resolver_for(ctx: FileContext) -> _MeshResolver:
+    """One shared mesh resolver per file (JG010 and JG012 consume it)."""
+    return ctx.rule_cache("sharding._MeshResolver",
+                          lambda: _MeshResolver(ctx))
+
+
+def _shard_map_calls(ctx: FileContext) -> Iterator[ast.Call]:
+    for node in ctx.walk():
+        if isinstance(node, ast.Call) \
+                and dotted_name(node.func) in _SHARD_MAP:
+            yield node
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+@register
+class PspecMeshAxesRule(Rule):
+    """A ``PartitionSpec`` axis name that is not an axis of the mesh it
+    is used with makes ``shard_map`` raise at trace time — but only when
+    that code path finally runs, which for pod-composition modes is on
+    the pod, not in the single-chip tests. When the mesh's axes resolve
+    statically (literal ``Mesh``/``MeshTopology`` construction visible
+    from the call site) the mismatch is a lint-time error instead.
+    """
+
+    code = "JG010"
+    summary = ("PartitionSpec names an axis the mesh at this "
+               "shard_map/NamedSharding call site does not declare")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        resolver = _resolver_for(ctx)
+        for call in ctx.walk():
+            if not isinstance(call, ast.Call):
+                continue
+            callee = dotted_name(call.func) or ""
+            spec_exprs: List[ast.expr] = []
+            mesh_expr: Optional[ast.expr] = None
+            if callee in _SHARD_MAP:
+                mesh_expr = _kw(call, "mesh") or (
+                    call.args[1] if len(call.args) > 1 else None)
+                for name in ("in_specs", "out_specs"):
+                    e = _kw(call, name)
+                    if e is not None:
+                        spec_exprs.append(e)
+            elif callee.rsplit(".", 1)[-1] == "NamedSharding":
+                if call.args:
+                    mesh_expr = call.args[0]
+                    spec_exprs = list(call.args[1:])
+            if mesh_expr is None or not spec_exprs:
+                continue
+            mesh_axes = resolver.axes_of(mesh_expr, call)
+            if mesh_axes is None:
+                continue  # mesh not statically resolvable: skip the site
+            seen: Set[Tuple[str, int]] = set()
+            for expr in spec_exprs:
+                for axis, node in _spec_axes(expr, ctx):
+                    if axis in mesh_axes:
+                        continue
+                    key = (axis, getattr(node, "lineno", 0))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield self.finding(
+                        ctx, node,
+                        f"PartitionSpec axis '{axis}' is not an axis of "
+                        f"the mesh used here (mesh axes: "
+                        f"{', '.join(mesh_axes)}) — shard_map will "
+                        f"reject this spec at trace time")
+
+
+@register
+class ShardMapAritySpecRule(Rule):
+    """``in_specs`` is matched to the wrapped function's arguments
+    positionally; a literal spec tuple whose length cannot match the
+    function's signature raises a structure error at trace time, far
+    from the definition. Checked when the function resolves lexically
+    (def or lambda) and the specs are a literal tuple/list.
+    """
+
+    code = "JG011"
+    summary = ("shard_map in_specs literal arity cannot match the wrapped "
+               "function's parameter count")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for call in _shard_map_calls(ctx):
+            if not call.args:
+                continue
+            target = call.args[0]
+            params: Optional[Tuple[int, int]] = None  # (required, total)
+            fname = None
+            if isinstance(target, ast.Lambda):
+                a = target.args
+                if a.vararg is None:
+                    total = len(a.args) + len(getattr(a, "posonlyargs", []))
+                    params = (total - len(a.defaults), total)
+                    fname = "<lambda>"
+            elif isinstance(target, ast.Name):
+                matches = ctx.jit_index._resolve_name(target.id, call)
+                if len(matches) == 1 and matches[0].args.vararg is None:
+                    fn = matches[0]
+                    total = len(fn.args.args) + len(
+                        getattr(fn.args, "posonlyargs", []))
+                    params = (total - len(fn.args.defaults), total)
+                    fname = fn.name
+            if params is None:
+                continue
+            specs = _kw(call, "in_specs")
+            if not isinstance(specs, (ast.Tuple, ast.List)):
+                continue
+            n = len(specs.elts)
+            required, total = params
+            if required <= n <= total:
+                continue
+            yield self.finding(
+                ctx, specs,
+                f"in_specs has {n} entr{'y' if n == 1 else 'ies'} but "
+                f"'{fname}' takes "
+                f"{required if required == total else f'{required}-{total}'}"
+                f" positional argument(s) — shard_map matches specs to "
+                f"arguments positionally and will raise at trace time")
+
+
+@register
+class CollectiveAxisRule(Rule):
+    """A collective (``lax.psum``/``all_gather``/``ppermute``/...)
+    naming an axis the enclosing ``shard_map`` mesh does not declare
+    fails only when that mode finally runs — the pod-readiness matrix
+    exists precisely because these drift silently. When the mesh
+    resolves statically and the axis is a literal (or a module-level
+    string constant, ``DATA_AXIS`` style), the drift is caught at lint
+    time. Axes passed as variables are skipped.
+    """
+
+    code = "JG012"
+    summary = ("collective inside shard_map names an axis absent from the "
+               "enclosing mesh")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        resolver = _resolver_for(ctx)
+        for call in _shard_map_calls(ctx):
+            mesh_expr = _kw(call, "mesh") or (
+                call.args[1] if len(call.args) > 1 else None)
+            if mesh_expr is None or not call.args:
+                continue
+            mesh_axes = resolver.axes_of(mesh_expr, call)
+            if mesh_axes is None:
+                continue
+            target = call.args[0]
+            fns: List[ast.AST] = []
+            if isinstance(target, ast.Lambda):
+                fns = [target]
+            elif isinstance(target, ast.Name):
+                fns = list(ctx.jit_index._resolve_name(target.id, call))
+            yield from self._check_body(ctx, fns, mesh_axes)
+
+    def _check_body(self, ctx: FileContext, fns: List[ast.AST],
+                    mesh_axes: Sequence[str]) -> Iterator[Finding]:
+        seen_fns: Set[int] = set()
+        work = list(fns)
+        while work:
+            fn = work.pop()
+            if id(fn) in seen_fns:
+                continue
+            seen_fns.add(id(fn))
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted_name(node.func) or ""
+                if isinstance(node.func, ast.Name):
+                    # transitively follow same-module helpers
+                    for sub in ctx.jit_index._resolve_name(node.func.id,
+                                                           node):
+                        if id(sub) not in seen_fns:
+                            work.append(sub)
+                last = callee.rsplit(".", 1)[-1]
+                if last not in _COLLECTIVE_AXIS_POS or not (
+                        callee.startswith(_COLLECTIVE_PREFIXES)
+                        or callee == last):
+                    continue
+                pos = _COLLECTIVE_AXIS_POS[last]
+                axis_expr = node.args[pos] if len(node.args) > pos \
+                    else _kw(node, "axis_name") or _kw(node, "axis")
+                if axis_expr is None:
+                    continue
+                elts = axis_expr.elts if isinstance(
+                    axis_expr, (ast.Tuple, ast.List)) else [axis_expr]
+                for elt in elts:
+                    axis = _axis_name_of(elt, ctx)
+                    if axis is not None and axis not in mesh_axes:
+                        yield self.finding(
+                            ctx, node,
+                            f"{callee}(..., '{axis}') names an axis the "
+                            f"enclosing shard_map mesh does not declare "
+                            f"(mesh axes: {', '.join(mesh_axes)}) — this "
+                            f"collective fails at trace time on the pod")

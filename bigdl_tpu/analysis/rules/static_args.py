@@ -74,7 +74,7 @@ class StaticArgsRule(Rule):
                 callee = dotted_name(dec.func) or _unwrap_partial(dec)
                 if callee in _JIT_WRAPPERS:
                     yield dec, fn
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.Call):
                 continue
             callee = dotted_name(node.func)

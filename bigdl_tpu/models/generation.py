@@ -31,6 +31,13 @@ from bigdl_tpu.nn.linear import LMHead, Linear, TiedLMHead
 from bigdl_tpu.nn.module import Module, _apply_lock, functional_apply
 from bigdl_tpu.nn.recurrent import TimeDistributed
 
+# Retained compiled decode programs per model (one per generate()
+# signature: batch/length/sampling tuple). Serving traffic varies the
+# signature, and each program closes over the model — unbounded growth
+# pins every program resident forever (graftlint JG014). Past the cap
+# the cache clears; a re-seen signature pays one recompile.
+_GENERATE_FNS_CAP = 32
+
 
 def filter_top_k(logprobs: jax.Array, k: int) -> jax.Array:
     """Keep the k highest-probability tokens; the rest get -inf."""
@@ -430,6 +437,11 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
                int(num_beams), float(length_penalty), bool(rolling_cache))
         fn = cache.get(sig)
         if fn is None:
+            if len(cache) >= _GENERATE_FNS_CAP:
+                # bound the per-signature family (graftlint JG014): a
+                # mixed-traffic server otherwise retains one compiled
+                # program per distinct (batch, length, sampling) forever
+                cache.clear()
             if num_beams > 1:
                 fn = _build_beam_fn(model, max_new_tokens, num_beams,
                                     length_penalty, eos_id, pad_id)
@@ -439,6 +451,7 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
                     greedy, eos_id, pad_id,
                     repetition_penalty=repetition_penalty,
                     min_new_tokens=min_new_tokens)
+            # graftlint: ignore[JG013] -- signature-keyed compile family is generate()'s documented contract (one program per static decode signature); bounded by _GENERATE_FNS_CAP above
             cache[sig] = fn
         if num_beams > 1:
             out = fn(params, buffers, prompt)
@@ -719,6 +732,7 @@ def generate_speculative(target: Module, draft: Module, prompt,
                 # drafts resident forever
                 cache.clear()
             fn = jax.jit(run)
+            # graftlint: ignore[JG013] -- per-(draft, signature) compile family by design; bounded by the clear-at-8 above
             cache[sig] = fn
         rng_in = key if sampled else jax.random.PRNGKey(0)
         result = fn(t_params, t_bufs, d_params, d_bufs, prompt, rng_in)

@@ -85,7 +85,12 @@ class LMServer:
         # requests displaced from a batch because their length differed:
         # consumed BEFORE the queue and in arrival order, so the next
         # batch anchors on the OLDEST held request — a sustained stream of
-        # one length can no longer starve another (ADVICE round 4)
+        # one length can no longer starve another (ADVICE round 4).
+        # _held is rewritten by the worker's gather AND by close() on the
+        # client thread; every mutation holds _held_lock (graftlint
+        # JG015: a close() racing a timed-out join could strand a held
+        # request forever — its done-event would never be set)
+        self._held_lock = threading.Lock()
         self._held: List[_Request] = []
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._run, daemon=True,
@@ -126,10 +131,11 @@ class LMServer:
         self._worker.join(timeout=5)
         # fail anything still queued — a submit() blocked without timeout
         # must not hang forever on a server that will never decode again
-        for req in self._held:
+        with self._held_lock:
+            stranded, self._held = self._held, []
+        for req in stranded:
             req.error = "server closed before the request was dispatched"
             req.done.set()
-        self._held = []
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -150,9 +156,9 @@ class LMServer:
         displaced from an earlier gather by length mismatch), so every
         request's wait is bounded by the batches ahead of it at arrival —
         strict arrival-order anchoring, no starvation."""
-        if self._held:
-            first = self._held.pop(0)
-        else:
+        with self._held_lock:
+            first = self._held.pop(0) if self._held else None
+        if first is None:
             try:
                 first = self._queue.get(timeout=0.1)
             except queue.Empty:
@@ -160,13 +166,14 @@ class LMServer:
         batch = [first]
         s = len(first.ids)
         # same-length held company joins immediately (no timeout burn)
-        still_held = []
-        for req in self._held:
-            if len(req.ids) == s and len(batch) < self.max_batch:
-                batch.append(req)
-            else:
-                still_held.append(req)
-        self._held = still_held
+        with self._held_lock:
+            still_held = []
+            for req in self._held:
+                if len(req.ids) == s and len(batch) < self.max_batch:
+                    batch.append(req)
+                else:
+                    still_held.append(req)
+            self._held = still_held
         deadline = _now() + self.batch_timeout
         while len(batch) < self.max_batch:
             remaining = deadline - _now()
@@ -176,7 +183,11 @@ class LMServer:
                 req = self._queue.get(timeout=remaining)
             except queue.Empty:
                 break
-            (batch if len(req.ids) == s else self._held).append(req)
+            if len(req.ids) == s:
+                batch.append(req)
+            else:
+                with self._held_lock:
+                    self._held.append(req)
         return batch
 
     def _run(self):
@@ -192,6 +203,21 @@ class LMServer:
                 for req in batch:
                     req.error = f"{type(e).__name__}: {e}"
                     req.done.set()
+        # stop-path drain ON THE WORKER: close() sweeps _held and the
+        # queue once after a BOUNDED join — when that join times out
+        # (slow decode), this loop may hold or dequeue a request AFTER
+        # the sweep; failing the leftovers here guarantees no submit()
+        # is ever stranded, whichever side runs last
+        with self._held_lock:
+            stranded, self._held = self._held, []
+        while True:
+            try:
+                stranded.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for req in stranded:
+            req.error = "server closed before the request was dispatched"
+            req.done.set()
 
     def _decode_batch(self, batch: List[_Request]):
         import jax

@@ -48,6 +48,13 @@ from bigdl_tpu.nn.module import functional_apply
 from bigdl_tpu.models.generation import _decode_modules, sample_token
 from bigdl_tpu.telemetry import get_registry, instruments, span
 
+# Retained prefill programs (one per distinct prompt length). 64 lengths
+# cover any sane bucketing; past that the cache clears and re-admits pay
+# a recompile — bounded memory beats unbounded program retention under
+# arbitrary-length traffic (graftlint JG014; ROADMAP #1 tracks the real
+# fix, chunked prefill = O(1) compiles).
+_PREFILL_CACHE_CAP = 64
+
 
 @dataclass
 class _Request:
@@ -138,6 +145,12 @@ class ContinuousLMServer:
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._stop = threading.Event()
         self._dead: Optional[str] = None     # set once; never cleared
+        # slot bookkeeping is touched by the worker thread AND by
+        # close()/client threads — every mutation of _free/_active holds
+        # this lock (found by graftlint JG015: close() clearing _active
+        # concurrently with the worker's admit/finish could double-free
+        # a slot when the join below times out)
+        self._state_lock = threading.Lock()
         self._free = list(range(slots))
         self._active: dict = {}          # slot -> _Slot
         self._last_tok = np.ones((slots,), np.int32)
@@ -195,10 +208,12 @@ class ContinuousLMServer:
         self._worker.join(timeout=10)
         for m in self._mhas + self._heads:
             m.disable_decode()
-        for sl in self._active.values():
+        with self._state_lock:
+            stranded = list(self._active.values())
+            self._active.clear()
+        for sl in stranded:
             sl.req.error = "server closed mid-generation"
             sl.req.done.set()
-        self._active.clear()
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -243,6 +258,14 @@ class ContinuousLMServer:
                 return lp[:, -1], bufs
 
             fn = jax.jit(run)
+            if len(self._prefill_fns) >= _PREFILL_CACHE_CAP:
+                # arbitrary-length traffic must not retain one compiled
+                # program per length forever (graftlint JG014)
+                self._prefill_fns.clear()
+            # one compile per DISTINCT prompt length — the known serving
+            # compile storm; bounded in count above, but the per-length
+            # compile latency itself is ROADMAP #1 (chunked prefill)
+            # graftlint: ignore[JG013] -- per-prompt-length compile family is the documented serving design until chunked prefill (ROADMAP #1); retention bounded by _PREFILL_CACHE_CAP
             self._prefill_fns[plen] = fn
             # first-seen prompt length == a fresh XLA program at next call
             self._tm.serving_recompiles_total.inc()
@@ -317,12 +340,15 @@ class ContinuousLMServer:
             # peek, insert, THEN pop: an insert failure must not leak the
             # slot. (The insert donates self.buffers; a RUNTIME failure
             # mid-insert can still invalidate them — compile-time errors,
-            # the common case, happen before donation.)
-            slot = self._free[-1]
+            # the common case, happen before donation.) The device-side
+            # insert runs OUTSIDE the state lock.
+            with self._state_lock:
+                slot = self._free[-1]
             with span("serving.insert", slot=slot):
                 self.buffers = self._insert()(
                     self.buffers, small, jnp.int32(slot), jnp.int32(plen))
-            self._free.pop()
+            with self._state_lock:
+                self._free.pop()
             # first token sampled == time-to-first-token for this request
             self._tm.serving_ttft_seconds.observe(
                 time.perf_counter() - req.t_submit)
@@ -334,7 +360,8 @@ class ContinuousLMServer:
             self._last_tok[slot] = tok
             if self._finish_if_done(slot, sl):
                 return True
-            self._active[slot] = sl
+            with self._state_lock:
+                self._active[slot] = sl
             self._tm.serving_slots_occupied.set(len(self._active))
             return True
         except Exception as e:  # noqa: BLE001 — fail the one request
@@ -353,9 +380,10 @@ class ContinuousLMServer:
             self._tm.serving_requests_completed_total.inc()
             self._tm.serving_request_latency_seconds.observe(
                 time.perf_counter() - sl.req.t_submit)
-            if slot in self._active:
-                del self._active[slot]
-            self._free.append(slot)
+            with self._state_lock:
+                if slot in self._active:
+                    del self._active[slot]
+                self._free.append(slot)
             self._tm.serving_slots_occupied.set(len(self._active))
             return True
         return False
@@ -369,11 +397,13 @@ class ContinuousLMServer:
         continuation is a new server."""
         self._dead = reason
         self._tm.serving_request_errors_total.inc(len(self._active))
-        for slot, sl in list(self._active.items()):
+        with self._state_lock:
+            stranded = list(self._active.items())
+            self._active.clear()
+            self._free.extend(slot for slot, _ in stranded)
+        for _slot, sl in stranded:
             sl.req.error = f"server died: {reason}"
             sl.req.done.set()
-            self._free.append(slot)
-        self._active.clear()
         self._tm.serving_slots_occupied.set(0)
         while True:
             try:
@@ -394,6 +424,28 @@ class ContinuousLMServer:
             self._die(f"{type(e).__name__}: {e}")
 
     def _run_loop(self):
+        self._serve_loop()
+        # stop-path drain ON THE WORKER (mirrors close()): the client-
+        # side sweep runs after a BOUNDED join, so on a timed-out join
+        # this loop may have admitted or dequeued a request after it —
+        # fail the leftovers here so nobody waits out a client timeout,
+        # whichever side runs last
+        with self._state_lock:
+            stranded = list(self._active.items())
+            self._active.clear()
+            self._free.extend(s for s, _ in stranded)
+        for _slot, sl in stranded:
+            sl.req.error = "server closed mid-generation"
+            sl.req.done.set()
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = "server closed before the request was dispatched"
+            req.done.set()
+
+    def _serve_loop(self):
         while not self._stop.is_set():
             # strict-FIFO admission into free slots (starvation-free)
             while self._free:

@@ -29,7 +29,8 @@ MultiHeadAttention twins.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+import warnings
+from typing import Optional, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -87,6 +88,37 @@ def _int8_matmul_pallas(x2, w_q, scale_row, interpret=False):
     return out[:m]
 
 
+# shapes already warned about: the fallback is per-call (traffic), the
+# warning is once per distinct (K, O) — loud, not spammy
+_FALLBACK_WARNED: Set[Tuple[int, int]] = set()
+
+
+def _note_lost_kernel(kdim: int, out_dim: int) -> None:
+    """A decode-shaped matmul whose output dim is OFF the tile quantum
+    silently loses the fused kernel (ADVICE: Qwen2's V=151936 runs the
+    XLA dequant path at ~half the int8 byte floor). Count the event
+    (``bigdl_int8_fallbacks_total`` — once per eager call, once per
+    TRACE under jit: the branch runs at trace time, so the counter
+    counts shapes/compilations that lost the kernel, not per-step
+    dispatches) and warn ONCE per shape, naming the shape and the
+    quantum so the fix (pad the vocab) is obvious from the log line."""
+    from bigdl_tpu.telemetry import get_registry, instruments
+    instruments(get_registry()).int8_fallbacks_total.inc()
+    key = (kdim, out_dim)
+    if key in _FALLBACK_WARNED:
+        return
+    _FALLBACK_WARNED.add(key)
+    quantum = _TO_CANDIDATES[-1]
+    warnings.warn(
+        f"int8_matmul: out_dim={out_dim} (K={kdim}) is off the output-"
+        f"tile quantum — no candidate in {_TO_CANDIDATES} divides it, so "
+        f"the fused int8 kernel is DISABLED for this shape and the XLA "
+        f"dequantize path runs instead (weight bytes re-read at bf16, "
+        f"~2x the int8 floor). Pad the output dimension to a multiple "
+        f"of {quantum} (e.g. pad the vocab) to recover the kernel.",
+        RuntimeWarning, stacklevel=3)
+
+
 def kernel_applicable(m: int, kdim: int, out_dim: int) -> bool:
     """Tiling gate: O must divide one of the output-tile candidates, K the
     lane quantum, and the whole-K int8 weight block must fit VMEM
@@ -116,6 +148,13 @@ def int8_matmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
         y = _int8_matmul_pallas(x2, w_q, scale_row, interpret=interpret)
         y = y.astype(compute_dtype)
     else:
+        if m <= 256 and kdim % 128 == 0 \
+                and all(out_dim % to for to in _TO_CANDIDATES):
+            # decode-shaped call that lost the kernel BECAUSE the output
+            # dim is off the tile quantum (a divisible-but-VMEM-capped
+            # tile is a deliberate exclusion padding can't fix): loud
+            # once, counted per trace
+            _note_lost_kernel(kdim, out_dim)
         w = w_q.astype(compute_dtype) * scale_row[:, None].astype(
             compute_dtype)
         y = jnp.matmul(x2.astype(compute_dtype), w.T)

@@ -114,6 +114,7 @@ def _evaluate_batches(fwd, params, buffers, batches, v_methods, cache):
         if fast_ok and sliceable and n == full_bs:
             if cache is not None and not scorer_cached:
                 cache.clear()  # fwd/methods changed: old entry is stale
+                # graftlint: ignore[JG013] -- one-entry cache: cleared immediately above, so at most one program is ever retained
                 cache[cache_key] = scorer
                 scorer_cached = True
             if acc is None:

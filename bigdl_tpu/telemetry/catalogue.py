@@ -116,6 +116,14 @@ METRIC_SPECS: List[MetricSpec] = [
                "Training restarts from a discovered snapshot; "
                "elastic=true when the process/device count changed "
                "(unknown = markerless legacy snapshot).", ("elastic",)),
+    # ---- kernel dispatch (ops/int8_matmul.py)
+    MetricSpec("bigdl_int8_fallbacks_total", "counter",
+               "int8_matmul shapes that LOST the fused kernel because the "
+               "output dim is off the tile quantum (XLA dequant fallback "
+               "at ~2x the int8 byte floor; ADVICE: Qwen2 V=151936). "
+               "Counted once per eager call / once per TRACE under jit "
+               "(the decision runs at trace time), and warned once per "
+               "shape."),
     # ---- legacy bridge (optim/metrics.py)
     MetricSpec("bigdl_legacy_metric", "gauge",
                "Legacy optim.Metrics counters bridged onto the registry "

@@ -11,11 +11,14 @@
 #   ./scripts/bigdl-tpu.sh resilience {validate|latest} <ckpt_dir>
 set -euo pipefail
 
-# --- lint subcommand: graftlint, the AST-based JAX-hazard linter
+# --- lint subcommand: graftlint, the whole-program JAX-hazard analyzer
 #     (docs/ANALYSIS.md). With no path arguments the CLI itself defaults
 #     to the tier-1 self-lint gate tree (bigdl_tpu/ + scripts/, resolved
 #     from the package location), so flags-only invocations like
-#     `lint --format json` cover the same tree.
+#     `lint --format json` cover the same tree. Fast local gating and CI
+#     annotation:
+#       ./scripts/bigdl-tpu.sh lint --changed HEAD     # changed files only
+#       ./scripts/bigdl-tpu.sh lint --sarif out.sarif  # SARIF 2.1.0 report
 if [[ "${1:-}" == "lint" ]]; then
   shift
   root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"

@@ -1,0 +1,17 @@
+"""JG010 positive: PartitionSpec names an axis the mesh doesn't have.
+
+The mesh declares ("data", "tensor") but the in_specs shard over
+"model" — the classic drift after a mesh-axis rename.
+"""
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def build(devs, fn, x):
+    mesh = Mesh(np.array(devs).reshape(2, 2), ("data", "tensor"))
+    sharded = shard_map(fn, mesh=mesh,
+                        in_specs=(P("model"),),   # "model" is not an axis
+                        out_specs=P())
+    sharding = NamedSharding(mesh, P("expert"))   # neither is "expert"
+    return sharded, sharding

@@ -1,0 +1,29 @@
+"""JG010 near-misses: axes that match the mesh, a MeshTopology-built
+mesh, module-level axis constants, and an unresolvable mesh (skipped).
+"""
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu.parallel.mesh import MeshTopology
+
+DATA_AXIS = "data"
+
+
+def build(devs, fn):
+    mesh = Mesh(np.array(devs).reshape(2, 2), ("data", "tensor"))
+    return shard_map(fn, mesh=mesh,
+                     in_specs=(P(DATA_AXIS), P(None, "tensor")),
+                     out_specs=P())
+
+
+def build_topo(fn):
+    mesh = MeshTopology(data=2, expert=4).build()  # axes: data, expert
+    return shard_map(fn, mesh=mesh, in_specs=(P("expert"),),
+                     out_specs=P("data"))
+
+
+def build_unknown(mesh, fn):
+    # mesh arrives as a parameter: axes unresolvable, site skipped
+    return shard_map(fn, mesh=mesh, in_specs=(P("anything"),),
+                     out_specs=P())

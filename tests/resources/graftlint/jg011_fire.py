@@ -1,0 +1,16 @@
+"""JG011 positive: in_specs arity can't match the wrapped function."""
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def loss(params, buffers, batch):
+    return params, buffers, batch
+
+
+def build(devs):
+    mesh = Mesh(np.array(devs), ("data",))
+    # loss takes 3 positional arguments; two specs can never match
+    return shard_map(loss, mesh=mesh,
+                     in_specs=(P(), P("data")),
+                     out_specs=P())

@@ -1,0 +1,32 @@
+"""JG011 near-misses: matching arity, defaulted params making a shorter
+spec tuple legal, a non-literal spec, and an unresolvable function.
+"""
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def loss(params, buffers, batch):
+    return params, buffers, batch
+
+
+def loss_defaults(params, batch, scale=1.0):
+    return params, batch, scale
+
+
+def build(devs, specs):
+    mesh = Mesh(np.array(devs), ("data",))
+    exact = shard_map(loss, mesh=mesh,
+                      in_specs=(P(), P(), P("data")), out_specs=P())
+    # 2 specs vs (2 required, 3 total) positional params: legal call shape
+    dflt = shard_map(loss_defaults, mesh=mesh,
+                     in_specs=(P(), P("data")), out_specs=P())
+    computed = shard_map(loss, mesh=mesh, in_specs=specs, out_specs=P())
+    return exact, dflt, computed
+
+
+def build_method(server, devs):
+    mesh = Mesh(np.array(devs), ("data",))
+    # attribute target: not lexically resolvable, skipped
+    return shard_map(server.step, mesh=mesh, in_specs=(P(),),
+                     out_specs=P())

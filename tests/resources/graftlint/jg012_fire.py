@@ -1,0 +1,18 @@
+"""JG012 positive: a collective inside shard_map names an axis the
+enclosing mesh does not declare (helper included via local call)."""
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def build(devs):
+    mesh = Mesh(np.array(devs), ("data",))
+
+    def reduce_helper(x):
+        return lax.psum(x, "tensor")   # mesh only has "data"
+
+    def loss(x):
+        return reduce_helper(x * x)
+
+    return shard_map(loss, mesh=mesh, in_specs=(P("data"),),
+                     out_specs=P())

@@ -1,0 +1,28 @@
+"""JG012 near-misses: collectives over declared axes (literal, module
+constant, and a variable axis which is skipped as unresolvable)."""
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def build(devs):
+    mesh = Mesh(np.array(devs).reshape(2, 2), ("data", "seq"))
+
+    def loss(x):
+        y = lax.psum(x, DATA_AXIS)
+        return lax.pmean(y, "seq")
+
+    return shard_map(loss, mesh=mesh, in_specs=(P("data"),),
+                     out_specs=P())
+
+
+def build_variable_axis(devs, axis_name):
+    mesh = Mesh(np.array(devs), ("data",))
+
+    def loss(x):
+        return lax.psum(x, axis_name)  # variable axis: skipped
+
+    return shard_map(loss, mesh=mesh, in_specs=(P("data"),),
+                     out_specs=P())

@@ -1,0 +1,28 @@
+"""JG013 positive: the real compile storm from models/serving.py —
+the continuous server's prefill jit cache keyed by prompt LENGTH
+(``_prefill()``), one fresh XLA program per distinct length seen in
+traffic. This fixture is the pre-fix serving pattern verbatim in shape:
+a dict of jit wrappers stored under a request-derived key."""
+import jax
+
+
+class ContinuousServer:
+    def __init__(self, model):
+        self.model = model
+        self._prefill_fns = {}
+
+    def _prefill(self, plen):
+        fn = self._prefill_fns.get(plen)
+        if fn is None:
+            model = self.model
+
+            def run(params, bufs, prompt):
+                return model.apply(params, bufs, prompt)
+
+            fn = jax.jit(run)
+            self._prefill_fns[plen] = fn  # one program per prompt length
+        return fn
+
+    def admit(self, req):
+        plen = len(req.ids)               # traffic decides the key
+        return self._prefill(plen)

@@ -1,0 +1,25 @@
+"""JG013 near-misses: constant-keyed program registry, a plain
+attribute-cached wrapper (serving's step/insert idiom), and a dict of
+non-jit values under a dynamic key."""
+import jax
+
+
+class Server:
+    def __init__(self, model):
+        self.model = model
+        self._fns = {}
+        self._step_fn = None
+        self._stats = {}
+
+    def programs(self):
+        self._fns["decode"] = jax.jit(self.model.decode)   # constant key
+        self._fns["insert"] = jax.jit(self.model.insert)
+        return self._fns
+
+    def step(self):
+        if self._step_fn is None:
+            self._step_fn = jax.jit(self.model.step)       # single slot
+        return self._step_fn
+
+    def record(self, plen, value):
+        self._stats[plen] = value       # dynamic key, but not a wrapper

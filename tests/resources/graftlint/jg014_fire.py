@@ -1,0 +1,25 @@
+"""JG014 positive: a jit-wrapper cache that grows on a loop-reachable
+path with no eviction anywhere in the module. The insert sits two call
+hops from the worker loop — only the whole-program call graph sees it
+(the serving ``_run_loop -> _admit -> _prefill`` shape)."""
+import jax
+
+
+class Worker:
+    def __init__(self, model):
+        self.model = model
+        self._programs = {}
+
+    def _compile_for(self, shape):
+        fn = self._programs.get(shape)
+        if fn is None:
+            fn = jax.jit(self.model.step)
+            self._programs[shape] = fn    # retained forever
+        return fn
+
+    def _handle(self, req):
+        return self._compile_for(len(req))
+
+    def run(self, requests):
+        while requests:
+            self._handle(requests.pop())

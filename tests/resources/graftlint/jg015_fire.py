@@ -1,0 +1,37 @@
+"""JG015 positive: the real pre-fix race from models/serving.py —
+``ContinuousLMServer``'s slot table written by the worker thread
+(admit/finish) AND by ``close()`` on the client thread, no lock
+anywhere. A close() racing a timed-out join double-frees a slot."""
+import queue
+import threading
+
+
+class ContinuousServer:
+    def __init__(self, slots):
+        self._queue = queue.Queue()
+        self._stop = threading.Event()
+        self._free = list(range(slots))
+        self._active = {}
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _admit(self, req):
+        slot = self._free.pop()
+        self._active[slot] = req          # worker-side write, no lock
+
+    def _finish(self, slot):
+        del self._active[slot]
+        self._free.append(slot)
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                req = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            self._admit(req)
+
+    def close(self):
+        self._stop.set()
+        self._worker.join(timeout=1)
+        self._active.clear()              # client-side write, no lock

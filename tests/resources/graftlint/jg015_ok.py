@@ -1,0 +1,37 @@
+"""JG015 near-misses: the fixed serving shape (every shared write holds
+the lock), worker-only attributes, __init__ writes (pre-thread-start),
+and sync-safe Event/Queue attributes."""
+import queue
+import threading
+
+
+class ContinuousServer:
+    def __init__(self, slots):
+        self._queue = queue.Queue()
+        self._stop = threading.Event()
+        self._state_lock = threading.Lock()
+        self._free = list(range(slots))
+        self._active = {}
+        self._steps = 0                   # worker-only after start
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _admit(self, req):
+        with self._state_lock:
+            slot = self._free.pop()
+            self._active[slot] = req
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._steps += 1              # only the worker writes this
+            try:
+                req = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            self._admit(req)
+
+    def close(self):
+        self._stop.set()
+        self._worker.join(timeout=1)
+        with self._state_lock:
+            self._active.clear()

@@ -1,0 +1,23 @@
+"""JG016 positive: two locks acquired in opposite orders — the scrape
+path takes registry -> family while the reset path takes family ->
+registry (one hop through a helper call)."""
+import threading
+
+_registry_lock = threading.Lock()
+_family_lock = threading.Lock()
+
+
+def scrape(families):
+    with _registry_lock:
+        with _family_lock:                # order: registry -> family
+            return list(families)
+
+
+def _drop(families, name):
+    with _registry_lock:                  # called under family lock
+        families.pop(name, None)
+
+
+def reset(families, name):
+    with _family_lock:                    # order: family -> registry
+        _drop(families, name)

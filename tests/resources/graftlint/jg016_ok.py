@@ -1,0 +1,25 @@
+"""JG016 near-misses: a consistent global acquisition order, and
+sequential (non-nested) acquisitions."""
+import threading
+
+_registry_lock = threading.Lock()
+_family_lock = threading.Lock()
+
+
+def scrape(families):
+    with _registry_lock:
+        with _family_lock:                # registry -> family everywhere
+            return list(families)
+
+
+def reset(families, name):
+    with _registry_lock:
+        with _family_lock:
+            families.pop(name, None)
+
+
+def sequential(families):
+    with _registry_lock:
+        snapshot = list(families)
+    with _family_lock:                    # released the first lock: fine
+        return snapshot

@@ -1,0 +1,21 @@
+"""JG017 positive: a blocking device sync executed while holding a
+lock — every thread contending for the lock stalls behind the
+transfer."""
+import threading
+
+import jax
+
+
+class LossTracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last = 0.0
+
+    def update(self, loss_array):
+        with self._lock:
+            loss_array.block_until_ready()        # device wait under lock
+            self._last = loss_array.item()        # and a host pull
+
+    def fetch(self, x):
+        with self._lock:
+            return jax.device_get(x)

@@ -1,0 +1,19 @@
+"""JG017 near-misses: sync outside the lock (copy the handle under it),
+and host-side mutation under the lock."""
+import threading
+
+import jax
+
+
+class LossTracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = None
+        self._last = 0.0
+
+    def update(self, loss_array):
+        with self._lock:
+            self._pending = loss_array    # just the handle, no transfer
+        value = float(jax.device_get(self._pending))  # sync lock-free
+        with self._lock:
+            self._last = value

@@ -1,0 +1,3 @@
+"""Cross-module graftlint fixture package: ``wrapper`` jits functions
+that call helpers in ``helpers`` — hazards only a whole-program pass
+can see (per-file analysis finds nothing in ``wrapper``)."""

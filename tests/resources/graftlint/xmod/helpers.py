@@ -1,0 +1,22 @@
+"""Module B: innocent-looking helpers. ``to_host`` syncs its argument,
+``noisy_norm`` has a trace-time side effect, ``draw`` consumes the key
+it is given — all invisible from the modules that call them."""
+import jax
+import numpy as np
+
+
+def to_host(x):
+    return float(np.asarray(x).sum())
+
+
+def deep_to_host(x):
+    return to_host(x) * 2.0             # chained: still syncs its arg
+
+
+def noisy_norm(x):
+    print("normalizing", x)             # fires at trace time under jit
+    return x / (x + 1)
+
+
+def draw(key, shape):
+    return jax.random.normal(key, shape)

@@ -1,0 +1,24 @@
+"""Module A: the jit wrappers. Per-file analysis sees nothing wrong
+here — every hazard lives behind the import boundary."""
+import jax
+import jax.numpy as jnp
+
+from xmod.helpers import deep_to_host, draw, noisy_norm, to_host
+
+
+@jax.jit
+def step(x):
+    y = jnp.sum(x * x)
+    y = noisy_norm(y)                   # JG002 fires in helpers.py
+    return to_host(y)                   # JG001: helper host-syncs y
+
+
+@jax.jit
+def step_chained(x):
+    return deep_to_host(jnp.sum(x))     # JG001 through two modules
+
+
+def sample_pair(key, shape):
+    a = draw(key, shape)                # helper draws from the key...
+    b = draw(key, shape)                # JG003: same key drawn again
+    return a, b

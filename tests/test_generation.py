@@ -920,3 +920,22 @@ class TestSpeculativeSampled:
         b = np.asarray(generate_speculative(target, draft,
                                             jnp.asarray(prompt), 5))
         np.testing.assert_array_equal(a, b)
+
+
+class TestGenerateCacheBound:
+    """Regression for the graftlint JG014 fix: the per-signature decode
+    program cache on the model is bounded by _GENERATE_FNS_CAP."""
+
+    def test_cache_clears_at_cap(self, monkeypatch):
+        from bigdl_tpu.models import generation as gen_mod
+        monkeypatch.setattr(gen_mod, "_GENERATE_FNS_CAP", 2)
+        model = tiny_lm(max_len=32)
+        prompt = jnp.ones((1, 3))
+        outs = {}
+        for n_new in (2, 3, 4):            # three distinct signatures
+            outs[n_new] = np.asarray(
+                generate(model, prompt, n_new, greedy=True))
+        assert len(model._generate_fns) <= 2
+        # a re-seen signature after eviction recompiles to the same tokens
+        again = np.asarray(generate(model, prompt, 2, greedy=True))
+        np.testing.assert_array_equal(again, outs[2])

@@ -18,7 +18,10 @@ from bigdl_tpu.analysis.__main__ import main as cli_main
 
 REPO = Path(__file__).resolve().parents[1]
 FIXTURES = REPO / "tests" / "resources" / "graftlint"
-ALL_CODES = [f"JG{i:03d}" for i in range(1, 9)]
+# JG009 is reserved; v2 added the sharding (010-012), compile-cache
+# (013-014) and concurrency (015-017) families
+ALL_CODES = [f"JG{i:03d}" for i in range(1, 9)] + \
+            [f"JG{i:03d}" for i in range(10, 18)]
 
 
 def _codes(path: Path):
@@ -200,9 +203,124 @@ class TestEngineCoverage:
         assert not res.findings  # JG004 didn't run: no stale verdict
 
 
+# ------------------------------------------------------------ whole program
+class TestWholeProgram:
+    """Cross-module propagation: the xmod fixture package hides every
+    hazard behind an import boundary — only the program pass sees them."""
+
+    def _by_name(self, results):
+        return {Path(r.path).name: [f.code for f in r.findings]
+                for r in results}
+
+    def test_cross_module_host_sync_at_call_site(self):
+        by = self._by_name(lint_paths([str(FIXTURES / "xmod")]))
+        # both the direct helper and the two-module chain are seen, and
+        # the findings land in wrapper.py where the tracer enters them
+        assert by["wrapper.py"].count("JG001") == 2
+
+    def test_extern_compiled_side_effect(self):
+        by = self._by_name(lint_paths([str(FIXTURES / "xmod")]))
+        assert "JG002" in by["helpers.py"]
+
+    def test_key_consumed_through_helper(self):
+        by = self._by_name(lint_paths([str(FIXTURES / "xmod")]))
+        assert "JG003" in by["wrapper.py"]
+
+    def test_per_file_pass_is_blind(self):
+        # the same wrapper linted alone is clean — pins that the findings
+        # above really come from cross-module facts, not local analysis
+        res = lint_file(str(FIXTURES / "xmod" / "wrapper.py"))
+        assert not res.findings
+
+    def test_dryrun_matrix_lints_clean(self):
+        # the sharding contracts validate against the real composition
+        # matrix: no false positives on the pod-readiness modes
+        results = lint_paths(
+            [str(REPO / "__graft_entry__.py"),
+             str(REPO / "tests" / "test_comm_contract.py")],
+            select=["JG010", "JG011", "JG012"])
+        findings = [f for r in results for f in r.findings]
+        assert not findings, "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------------------- sarif
+class TestSarif:
+    def test_report_shape_is_sarif_2_1_0(self):
+        import json
+        from bigdl_tpu.analysis import render_sarif
+        results = lint_paths([str(FIXTURES / "jg001_fire.py")])
+        doc = json.loads(render_sarif(results))
+        assert doc["version"] == "2.1.0"
+        assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+        driver = doc["runs"][0]["tool"]["driver"]
+        assert driver["name"] == "graftlint"
+        assert [r["id"] for r in driver["rules"]] == ALL_CODES
+        for rule in driver["rules"]:
+            assert rule["shortDescription"]["text"]
+            assert rule["fullDescription"]["text"]
+            assert rule["defaultConfiguration"]["level"] == "warning"
+        results_ = doc["runs"][0]["results"]
+        assert any(r["ruleId"] == "JG001" for r in results_)
+        for r in results_:
+            assert r["message"]["text"]
+            loc = r["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"]
+            assert loc["region"]["startLine"] >= 1
+            assert loc["region"]["startColumn"] >= 1
+            # ruleIndex must point back at its own rule
+            assert driver["rules"][r["ruleIndex"]]["id"] == r["ruleId"]
+
+    def test_suppressed_findings_carry_suppressions(self):
+        from bigdl_tpu.analysis import sarif_report
+        src = ("import jax, jax.numpy as jnp\n"
+               "@jax.jit\n"
+               "def f(x):\n"
+               "    return float(jnp.sum(x))"
+               "  # graftlint: ignore[JG001] -- test fixture\n")
+        doc = sarif_report([lint_source("<s>", src)])
+        results = doc["runs"][0]["results"]
+        assert len(results) == 1
+        assert results[0]["suppressions"] == [{"kind": "inSource"}]
+
+    def test_cli_sarif_flags(self, tmp_path, capsys):
+        import json
+        out_path = tmp_path / "report.sarif"
+        rc = cli_main([str(FIXTURES / "jg001_fire.py"),
+                       "--sarif", str(out_path)])
+        assert rc == 1  # exit still reflects unsuppressed findings
+        doc = json.loads(out_path.read_text())
+        assert doc["version"] == "2.1.0"
+        capsys.readouterr()
+        assert cli_main([str(FIXTURES / "jg001_ok.py"),
+                         "--format", "sarif"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["runs"][0]["results"] == []
+
+
+# ----------------------------------------------------------------- changed
+class TestChangedFilter:
+    def test_bogus_ref_is_usage_error(self):
+        assert cli_main(["--changed", "no-such-ref-xyz",
+                         str(FIXTURES)]) == 2
+
+    def test_changed_vs_head_smoke(self, capsys):
+        # a committed clean fixture: whether or not it differs from HEAD
+        # the run must lint at most that file and exit 0
+        rc = cli_main(["--changed", "HEAD",
+                       str(FIXTURES / "jg001_ok.py")])
+        assert rc == 0
+
+    def test_changed_files_subset(self):
+        from bigdl_tpu.analysis.__main__ import changed_files
+        files = changed_files("HEAD", [str(FIXTURES)])
+        assert all(f.endswith(".py") and Path(f).exists() for f in files)
+        lintable = {str(p) for p in FIXTURES.rglob("*.py")}
+        assert set(files) <= lintable
+
+
 # ---------------------------------------------------------------- registry
 class TestRegistry:
-    def test_eight_rules_registered(self):
+    def test_sixteen_rules_registered(self):
         rules = all_rules()
         assert [r.code for r in rules] == ALL_CODES
         for rule in rules:
@@ -238,7 +356,7 @@ class TestReporters:
         assert cli_main([str(FIXTURES / "jg001_ok.py")]) == 0
         assert cli_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
-        assert "JG008" in out  # rule table lists every rule
+        assert "JG008" in out and "JG017" in out  # table lists every rule
         assert cli_main(["--select", "NOPE", "."]) == 2
         assert cli_main([str(FIXTURES / "no_such_dir")]) == 2
 
@@ -259,8 +377,10 @@ class TestSelfLint:
             + "\n".join(f.render() for f in findings))
         # sanity: the walk actually covered the tree
         assert len(results) > 100
-        # pure-AST analysis must stay far inside the tier-1 budget
-        assert elapsed < 10.0, f"self-lint took {elapsed:.1f}s (budget 10s)"
+        # pure-AST analysis (now a WHOLE-PROGRAM pass: shared index,
+        # summary fixpoints, 16 rules) must stay inside the tier-1
+        # budget on 2 cores
+        assert elapsed < 15.0, f"self-lint took {elapsed:.1f}s (budget 15s)"
 
     def test_every_suppression_carries_a_reason(self):
         # JG000 (reasonless ignore) is part of findings, so the clean

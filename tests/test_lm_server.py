@@ -164,3 +164,38 @@ class TestHTTPRim:
         finally:
             httpd.shutdown()
             srv.close()
+
+
+class TestHeldListLock:
+    """Regression for the graftlint JG015 fix: the held-request list is
+    rewritten by the worker's gather AND by close() — a close racing the
+    batcher must fail every held request exactly once, never strand one."""
+
+    def test_close_fails_held_requests_without_stranding(self):
+        from bigdl_tpu.utils.rng import manual_seed
+        manual_seed(11)
+        model = transformer.build_lm(32, 16, 2, 32, num_layers=1,
+                                     max_len=64)
+        # a long batch window so mixed-length followers pile up in _held
+        srv = LMServer(model, max_batch=4, batch_timeout_ms=400,
+                       max_new_tokens=4, greedy=True)
+        results = []
+
+        def client(ids):
+            try:
+                results.append(("ok", srv.submit(ids, 2, timeout=30)))
+            except (RuntimeError, TimeoutError) as e:
+                results.append(("err", str(e)))
+
+        threads = [threading.Thread(target=client, args=(ids,))
+                   for ids in ([3, 1], [2, 5, 4], [9], [7, 7, 7, 7])]
+        for t in threads:
+            t.start()
+        import time
+        time.sleep(0.15)      # let the gather hold the mismatched lengths
+        srv.close()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads)
+        assert len(results) == 4           # nobody hangs, nobody is lost
+        assert not srv._held

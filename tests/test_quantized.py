@@ -273,3 +273,46 @@ class TestInt8MatmulKernel:
         got = np.asarray(ref_q.forward(x), np.float32)
         want = np.asarray(deq.forward(x), np.float32)
         np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)
+
+
+class TestLostKernelWarning:
+    """ADVICE satellite: a vocab off the tile quantum must not lose the
+    fused kernel SILENTLY — one loud warning naming shape + quantum,
+    plus a bigdl_int8_fallbacks_total count per dispatch."""
+
+    def _call(self, out_dim, kdim=128, m=2):
+        from bigdl_tpu.ops.int8_matmul import int8_matmul
+        x = jnp.ones((m, kdim), jnp.float32)
+        w_q = jnp.ones((out_dim, kdim), jnp.int8)
+        scale = jnp.ones((out_dim, 1), jnp.float32)
+        return int8_matmul(x, w_q, scale)
+
+    def test_warns_once_and_counts_every_fallback(self, monkeypatch):
+        import warnings as warnings_mod
+        from bigdl_tpu.ops import int8_matmul as mod
+        from bigdl_tpu.telemetry import get_registry, instruments
+        monkeypatch.setattr(mod, "_FALLBACK_WARNED", set())
+        counter = instruments(get_registry()).int8_fallbacks_total
+        before = counter.value
+        # V=150: no tile candidate divides it — the Qwen2-shaped loss
+        with pytest.warns(RuntimeWarning) as rec:
+            out = self._call(150)
+        assert out.shape == (2, 150)
+        msgs = [str(w.message) for w in rec
+                if "tile quantum" in str(w.message)]
+        assert len(msgs) == 1
+        assert "out_dim=150" in msgs[0] and "256" in msgs[0]
+        # same shape again: counted, NOT re-warned
+        with warnings_mod.catch_warnings():
+            warnings_mod.simplefilter("error", RuntimeWarning)
+            self._call(150)
+        assert counter.value == before + 2
+
+    def test_aligned_vocab_and_big_m_stay_silent(self, monkeypatch):
+        import warnings as warnings_mod
+        from bigdl_tpu.ops import int8_matmul as mod
+        monkeypatch.setattr(mod, "_FALLBACK_WARNED", set())
+        with warnings_mod.catch_warnings():
+            warnings_mod.simplefilter("error", RuntimeWarning)
+            self._call(256)          # on-quantum: kernel path, no warning
+            self._call(150, m=512)   # big-M prefill fallback: deliberate

@@ -300,3 +300,60 @@ class TestContinuousSampling:
             assert len({o[0] for o in outs}) > 1
         finally:
             srv.close()
+
+
+class TestCompileCacheBound:
+    """Regression for the graftlint JG014 fix: the per-prompt-length
+    prefill program cache is bounded (arbitrary-length traffic must not
+    retain one compiled program per length forever)."""
+
+    def test_prefill_cache_clears_at_cap(self, monkeypatch):
+        from bigdl_tpu.models import serving as serving_mod
+        monkeypatch.setattr(serving_mod, "_PREFILL_CACHE_CAP", 2)
+        model, ref = _mk_model(), _mk_model()
+        srv = ContinuousLMServer(model, slots=2, max_len=32, greedy=True,
+                                 decode_block=2)
+        try:
+            for ids in ([4], [4, 7], [4, 7, 2], [4, 7, 2, 9]):
+                got = srv.submit(ids, max_new_tokens=3, timeout=120)
+                # eviction must never change what gets served
+                assert got == _ref_continuation(ref, ids, 3)
+            assert len(srv._prefill_fns) <= 2
+        finally:
+            srv.close()
+
+
+class TestSlotStateLock:
+    """Regression for the graftlint JG015 fix: slot bookkeeping is
+    mutated by the worker AND by close() — under concurrent traffic the
+    accounting must stay consistent (no slot double-freed, no request
+    left hanging)."""
+
+    def test_concurrent_submits_and_close_keep_slots_consistent(self):
+        model = _mk_model()
+        srv = ContinuousLMServer(model, slots=3, max_len=32, greedy=True,
+                                 decode_block=2)
+        outcomes = []
+
+        def client(i):
+            ids = [1 + (i % 5)] * (1 + i % 3)
+            try:
+                outcomes.append(("ok", srv.submit(ids, max_new_tokens=4,
+                                                  timeout=60)))
+            except (RuntimeError, TimeoutError) as e:
+                outcomes.append(("err", str(e)))  # a mid-close failure
+                # is allowed — a hang or corrupted accounting is not
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        srv.close()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+        assert len(outcomes) == 8          # every client got an answer
+        assert len(srv._free) == len(set(srv._free))   # no double-free
+        assert set(srv._free) <= set(range(3))
+        assert not srv._active
