@@ -320,7 +320,16 @@ def worker_train(name, batch, steps, budget_s, precision="bf16",
         return jax.lax.fori_loop(0, K, body,
                                  (params, buffers, opt_state))
 
-    jstep = jax.jit(multi_step, donate_argnums=(0, 1, 2))
+    # compile flight recorder (telemetry/profiling.py): the BENCH JSON
+    # carries compile counts, cumulative compile seconds and a
+    # cost-analysis MFU next to the step-time histogram, so the perf
+    # trajectory (BENCH_r0*.json) is regression-diffable on compiles,
+    # not just step time. Private registry: single-purpose worker.
+    from bigdl_tpu.telemetry import MetricsRegistry, instruments
+    from bigdl_tpu.telemetry.profiling import mfu as cost_mfu, tracked_jit
+    bench_registry = MetricsRegistry()
+    jstep = tracked_jit(multi_step, site="bench.step",
+                        registry=bench_registry, donate_argnums=(0, 1, 2))
 
     state = {
         "s": (params, buffers, opt_state),
@@ -332,17 +341,29 @@ def worker_train(name, batch, steps, budget_s, precision="bf16",
         return {"s": jstep(p, b, o, data, labels)}
 
     # step-time distribution for the BENCH JSON (telemetry is jax-free and
-    # cheap: one histogram observe per timed step). Private registry: the
-    # worker is single-purpose, no global scrape to feed.
-    from bigdl_tpu.telemetry import MetricsRegistry, instruments
-    step_hist = instruments(MetricsRegistry()).bench_step_seconds
+    # cheap: one histogram observe per timed step)
+    step_hist = instruments(bench_registry).bench_step_seconds
     rps = _timed_loop(step, state, budget_s, steps, batch * K,
                       step_hist=step_hist)
+    summary = step_hist.summary()
+    ev = jstep.last_event
+    m = cost_mfu(ev.flops if ev is not None else None, summary["mean"])
     telem = {
         # per-DISPATCH wall-clock summary (each dispatch = K fused steps)
-        "step_seconds": step_hist.summary(),
+        "step_seconds": summary,
         "steps_per_dispatch": K,
         "records_per_sec": round(rps * rec_factor, 2),
+        # compile flight recorder: how many programs this run built, what
+        # they cost to build, and what one dispatch accounts for
+        "compiles": jstep.compiles,
+        "compile_seconds_total": round(
+            sum(e.seconds for e in jstep.events), 3),
+        "program_flops": ev.flops if ev is not None else None,
+        "program_bytes_accessed": (ev.bytes_accessed
+                                   if ev is not None else None),
+        # cost-analysis MFU: program FLOPs / mean dispatch wall / peak —
+        # None off-TPU unless BIGDL_TPU_PEAK_FLOPS names the roof
+        "mfu_cost_analysis": round(m, 4) if m is not None else None,
     }
     return rps * rec_factor, model, telem
 

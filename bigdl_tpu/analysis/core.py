@@ -177,6 +177,11 @@ def _scan_suppressions(source: str) -> Tuple[Dict[int, List[Suppression]],
 _JIT_WRAPPERS = {
     "jax.jit", "jit", "jax.pmap", "pmap", "pjit",
     "jax.experimental.pjit.pjit",
+    # the telemetry compile flight recorder wraps jax.jit — its wrapped
+    # functions are compiled contexts and its call sites build compile
+    # families exactly like jit's (telemetry/profiling.py)
+    "tracked_jit", "profiling.tracked_jit",
+    "bigdl_tpu.telemetry.profiling.tracked_jit",
 }
 # dotted callables that trace the function they wrap
 _TRACE_WRAPPERS = _JIT_WRAPPERS | {

@@ -35,8 +35,21 @@ from bigdl_tpu.nn.recurrent import TimeDistributed
 # signature: batch/length/sampling tuple). Serving traffic varies the
 # signature, and each program closes over the model — unbounded growth
 # pins every program resident forever (graftlint JG014). Past the cap
-# the cache clears; a re-seen signature pays one recompile.
+# the OLDEST signature's program is evicted (single entry, counted in
+# bigdl_compile_cache_evictions_total{site="generation.decode"} —
+# clear-at-cap forced every live signature to recompile at once); a
+# re-seen evicted signature pays one recompile.
 _GENERATE_FNS_CAP = 32
+
+
+def _evict_oldest(cache: dict, site: str) -> None:
+    """Drop the least-recently-inserted program from a signature-keyed
+    compile cache and count it (oldest-first single-entry eviction — the
+    anti-storm replacement for clear-at-cap)."""
+    from bigdl_tpu.telemetry import get_registry, instruments
+    cache.pop(next(iter(cache)))
+    instruments(get_registry()).compile_cache_evictions_total.labels(
+        site=site).inc()
 
 
 def filter_top_k(logprobs: jax.Array, k: int) -> jax.Array:
@@ -192,7 +205,8 @@ def _build_decode_fn(model: Module, max_new_tokens: int, temperature: float,
         toks = jnp.concatenate([tok[:, None], rest.T], axis=1)
         return jnp.concatenate([prompt, toks.astype(prompt.dtype)], axis=1)
 
-    return jax.jit(run)
+    from bigdl_tpu.telemetry.profiling import tracked_jit
+    return tracked_jit(run, site="generation.decode")
 
 
 def _map_cache_leaves(buffers, fn, other_fn=None):
@@ -292,7 +306,8 @@ def _build_beam_fn(model: Module, max_new_tokens: int, num_beams: int,
         return jnp.concatenate(
             [prompt, best_seq.astype(prompt.dtype)], axis=1)
 
-    return jax.jit(run)
+    from bigdl_tpu.telemetry.profiling import tracked_jit
+    return tracked_jit(run, site="generation.beam")
 
 
 def generate(model: Module, prompt, max_new_tokens: int, *,
@@ -437,11 +452,13 @@ def generate(model: Module, prompt, max_new_tokens: int, *,
                int(num_beams), float(length_penalty), bool(rolling_cache))
         fn = cache.get(sig)
         if fn is None:
-            if len(cache) >= _GENERATE_FNS_CAP:
+            while len(cache) >= _GENERATE_FNS_CAP:
                 # bound the per-signature family (graftlint JG014): a
                 # mixed-traffic server otherwise retains one compiled
-                # program per distinct (batch, length, sampling) forever
-                cache.clear()
+                # program per distinct (batch, length, sampling) forever.
+                # Oldest-first, ONE entry — clearing everything forced
+                # every live signature to recompile right after the wipe
+                _evict_oldest(cache, "generation.decode")
             if num_beams > 1:
                 fn = _build_beam_fn(model, max_new_tokens, num_beams,
                                     length_penalty, eos_id, pad_id)
@@ -726,13 +743,14 @@ def generate_speculative(target: Module, draft: Module, prompt,
                sampled, float(temperature))
         fn = cache.get(sig)
         if fn is None:
-            if len(cache) >= 8:
+            while len(cache) >= 8:
                 # bound the cache: each program closes over a draft Module
                 # (params included) — unbounded growth would pin dropped
-                # drafts resident forever
-                cache.clear()
-            fn = jax.jit(run)
-            # graftlint: ignore[JG013] -- per-(draft, signature) compile family by design; bounded by the clear-at-8 above
+                # drafts resident forever. Oldest-first single eviction.
+                _evict_oldest(cache, "generation.speculative")
+            from bigdl_tpu.telemetry.profiling import tracked_jit
+            fn = tracked_jit(run, site="generation.speculative")
+            # graftlint: ignore[JG013] -- per-(draft, signature) compile family by design; bounded by the oldest-first eviction at 8 above
             cache[sig] = fn
         rng_in = key if sampled else jax.random.PRNGKey(0)
         result = fn(t_params, t_bufs, d_params, d_bufs, prompt, rng_in)

@@ -28,6 +28,7 @@ Design (TPU-first):
 
 from __future__ import annotations
 
+import itertools
 import json
 import queue
 import threading
@@ -36,7 +37,11 @@ from typing import List, Optional
 
 import numpy as np
 
-from bigdl_tpu.telemetry import get_registry, instruments, span
+from bigdl_tpu.telemetry import get_registry, instruments, span, tracing
+
+# Chrome-trace lifecycle ids for lmserver.request async events (matched
+# on (cat, id, name), so they may overlap the continuous server's ids)
+_REQUEST_IDS = itertools.count(1)
 
 
 @dataclass
@@ -47,6 +52,7 @@ class _Request:
     result: Optional[List[int]] = None  # continuation ids (1-based)
     error: Optional[str] = None
     t_submit: float = 0.0               # perf_counter at submit (batch wait)
+    rid: int = 0                        # trace-lifecycle id
 
 
 class LMServer:
@@ -112,7 +118,10 @@ class LMServer:
             raise ValueError(f"max_new_tokens {max_new} exceeds the "
                              f"server's decode budget {self.max_new_tokens}")
         req = _Request(ids, max_new)
+        req.rid = next(_REQUEST_IDS)
         req.t_submit = _now()
+        tracing.async_begin("lmserver.request", req.rid,
+                            prompt_len=len(ids), max_new=max_new)
         self._queue.put(req)
         self._tm.lmserver_queue_depth.set(self.queue_depth)
         if not req.done.wait(timeout):
@@ -136,6 +145,7 @@ class LMServer:
         for req in stranded:
             req.error = "server closed before the request was dispatched"
             req.done.set()
+            tracing.async_end("lmserver.request", req.rid, error=req.error)
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -143,6 +153,7 @@ class LMServer:
                 break
             req.error = "server closed before the request was dispatched"
             req.done.set()
+            tracing.async_end("lmserver.request", req.rid, error=req.error)
 
     @property
     def batches_served(self) -> int:
@@ -203,6 +214,8 @@ class LMServer:
                 for req in batch:
                     req.error = f"{type(e).__name__}: {e}"
                     req.done.set()
+                    tracing.async_end("lmserver.request", req.rid,
+                                      error=req.error)
         # stop-path drain ON THE WORKER: close() sweeps _held and the
         # queue once after a BOUNDED join — when that join times out
         # (slow decode), this loop may hold or dequeue a request AFTER
@@ -218,6 +231,7 @@ class LMServer:
         for req in stranded:
             req.error = "server closed before the request was dispatched"
             req.done.set()
+            tracing.async_end("lmserver.request", req.rid, error=req.error)
 
     def _decode_batch(self, batch: List[_Request]):
         import jax
@@ -228,6 +242,14 @@ class LMServer:
         self._tm.lmserver_batch_wait_seconds.observe(
             _now() - batch[0].t_submit)
         self._tm.lmserver_batch_size.observe(len(batch))
+        if tracing.is_enabled():
+            # dispatch marks on every member's lifecycle lane, with each
+            # request's own queue+gather wait (batch-wait attribution)
+            t_disp = _now()
+            for req in batch:
+                tracing.async_instant("lmserver.request", req.rid,
+                                      phase="dispatch", batch=len(batch),
+                                      wait_s=round(t_disp - req.t_submit, 6))
         s = len(batch[0].ids)
         # batch-bucket: pad with copies of row 0 to the next power of two —
         # dummy rows cost compute but keep the compile cache at
@@ -257,6 +279,8 @@ class LMServer:
                 cont = cont[:cont.index(eos) + 1]  # keep eos, strip pad tail
             req.result = cont
             req.done.set()
+            tracing.async_end("lmserver.request", req.rid,
+                              tokens=len(cont))
 
 
 def _now() -> float:

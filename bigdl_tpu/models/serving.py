@@ -34,6 +34,7 @@ track a shared scalar position), no beam search. Sampling is the server's
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -46,14 +47,23 @@ import numpy as np
 
 from bigdl_tpu.nn.module import functional_apply
 from bigdl_tpu.models.generation import _decode_modules, sample_token
-from bigdl_tpu.telemetry import get_registry, instruments, span
+from bigdl_tpu.telemetry import get_registry, instruments, span, tracing
+from bigdl_tpu.telemetry.profiling import (sample_device_memory,
+                                           tracked_jit)
 
 # Retained prefill programs (one per distinct prompt length). 64 lengths
-# cover any sane bucketing; past that the cache clears and re-admits pay
-# a recompile — bounded memory beats unbounded program retention under
-# arbitrary-length traffic (graftlint JG014; ROADMAP #1 tracks the real
-# fix, chunked prefill = O(1) compiles).
+# cover any sane bucketing; past that the OLDEST length's program is
+# evicted (single-entry, counted in
+# bigdl_compile_cache_evictions_total{site="serving.prefill"}) and a
+# re-seen length pays one recompile — bounded memory beats unbounded
+# program retention under arbitrary-length traffic (graftlint JG014;
+# ROADMAP #1 tracks the real fix, chunked prefill = O(1) compiles).
 _PREFILL_CACHE_CAP = 64
+
+# One id per submitted request, process-wide: the Chrome-trace async
+# lifecycle key (serving.request) and the rid arg on every phase span.
+# itertools.count is GIL-atomic — submit() runs on client threads.
+_REQUEST_IDS = itertools.count(1)
 
 
 @dataclass
@@ -64,6 +74,7 @@ class _Request:
     result: Optional[List[int]] = None
     error: Optional[str] = None
     t_submit: float = 0.0               # perf_counter at submit (TTFT/SLO)
+    rid: int = 0                        # trace-lifecycle id (serving.request)
 
 
 class _Slot:
@@ -176,13 +187,20 @@ class ContinuousLMServer:
             # queue, and waiting out the client timeout helps nobody
             raise RuntimeError(f"server is dead: {self._dead}")
         req = _Request(ids, max_new)
+        req.rid = next(_REQUEST_IDS)
         req.t_submit = time.perf_counter()
+        # request lifecycle: one async lane per rid in the Chrome trace —
+        # submit opens it, admission marks it, completion/failure closes
+        # it; the queue_wait/prefill/insert spans carry the same rid
+        tracing.async_begin("serving.request", req.rid,
+                            prompt_len=len(ids), max_new=max_new)
         self._queue.put(req)
         if self._dead is not None and not req.done.is_set():
             # the worker died between the check and the enqueue; its final
             # drain may have missed this request — fail it here
             req.error = f"server is dead: {self._dead}"
             req.done.set()
+            tracing.async_end("serving.request", req.rid, error=req.error)
         self._tm.serving_queue_depth.set(self._queue.qsize())
         if not req.done.wait(timeout):
             raise TimeoutError("decode did not complete in time")
@@ -214,6 +232,8 @@ class ContinuousLMServer:
         for sl in stranded:
             sl.req.error = "server closed mid-generation"
             sl.req.done.set()
+            tracing.async_end("serving.request", sl.req.rid,
+                              error=sl.req.error)
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -221,6 +241,7 @@ class ContinuousLMServer:
                 break
             req.error = "server closed before the request was dispatched"
             req.done.set()
+            tracing.async_end("serving.request", req.rid, error=req.error)
 
     @property
     def batches_served(self) -> int:
@@ -257,11 +278,18 @@ class ContinuousLMServer:
                                             training=False)
                 return lp[:, -1], bufs
 
-            fn = jax.jit(run)
-            if len(self._prefill_fns) >= _PREFILL_CACHE_CAP:
+            fn = tracked_jit(run, site="serving.prefill",
+                             registry=self.registry)
+            while len(self._prefill_fns) >= _PREFILL_CACHE_CAP:
                 # arbitrary-length traffic must not retain one compiled
-                # program per length forever (graftlint JG014)
-                self._prefill_fns.clear()
+                # program per length forever (graftlint JG014) — and
+                # clear-at-cap caused an eviction STORM: every live
+                # prompt length recompiled immediately after the wipe.
+                # Oldest-first single-entry eviction drops exactly one
+                # length, counted so the scrape shows cache pressure.
+                self._prefill_fns.pop(next(iter(self._prefill_fns)))
+                self._tm.compile_cache_evictions_total.labels(
+                    site="serving.prefill").inc()
             # one compile per DISTINCT prompt length — the known serving
             # compile storm; bounded in count above, but the per-length
             # compile latency itself is ROADMAP #1 (chunked prefill)
@@ -292,7 +320,9 @@ class ContinuousLMServer:
                         out.append(bg)
                 return jax.tree_util.tree_unflatten(treedef, out)
 
-            self._insert_fn = jax.jit(run, donate_argnums=(0,))
+            self._insert_fn = tracked_jit(run, site="serving.insert",
+                                          registry=self.registry,
+                                          donate_argnums=(0,))
             self._tm.serving_recompiles_total.inc()
         return self._insert_fn
 
@@ -316,15 +346,22 @@ class ContinuousLMServer:
                 (bufs, _), out = jax.lax.scan(one, (bufs, toks), keys)
                 return out.T, bufs      # (slots, block)
 
-            self._step_fn = jax.jit(run, donate_argnums=(1,))
+            self._step_fn = tracked_jit(run, site="serving.step",
+                                        registry=self.registry,
+                                        donate_argnums=(1,))
             self._tm.serving_recompiles_total.inc()
         return self._step_fn
 
     # --------------------------------------------------------------- worker
     def _admit(self, req: _Request) -> bool:
         plen = len(req.ids)
+        t_admit = time.perf_counter()
+        # queue-wait attribution: the retrodicted submit->admission span
+        # plus an instant on the request's async lane, both under its rid
+        tracing.complete_event("serving.queue_wait", req.t_submit, t_admit,
+                               rid=req.rid)
         try:
-            with span("serving.prefill", plen=plen):
+            with span("serving.prefill", plen=plen, rid=req.rid):
                 with self._single_mode():
                     prompt = jnp.asarray(
                         np.asarray(req.ids, np.float32)[None])
@@ -344,11 +381,16 @@ class ContinuousLMServer:
             # insert runs OUTSIDE the state lock.
             with self._state_lock:
                 slot = self._free[-1]
-            with span("serving.insert", slot=slot):
+            with span("serving.insert", slot=slot, rid=req.rid):
                 self.buffers = self._insert()(
                     self.buffers, small, jnp.int32(slot), jnp.int32(plen))
             with self._state_lock:
                 self._free.pop()
+            tracing.async_instant("serving.request", req.rid,
+                                  phase="admitted", slot=slot)
+            # admission grows the live KV footprint — one of the two
+            # watermark sampling points (the other is the step boundary)
+            sample_device_memory(self.registry)
             # first token sampled == time-to-first-token for this request
             self._tm.serving_ttft_seconds.observe(
                 time.perf_counter() - req.t_submit)
@@ -367,6 +409,7 @@ class ContinuousLMServer:
         except Exception as e:  # noqa: BLE001 — fail the one request
             req.error = f"{type(e).__name__}: {e}"
             req.done.set()
+            tracing.async_end("serving.request", req.rid, error=req.error)
             self._tm.serving_request_errors_total.inc()
             return False
 
@@ -376,6 +419,8 @@ class ContinuousLMServer:
         if hit_eos or sl.new_count >= sl.req.max_new:
             sl.req.result = sl.emitted[:sl.req.max_new]
             sl.req.done.set()
+            tracing.async_end("serving.request", sl.req.rid,
+                              tokens=len(sl.req.result))
             self._n_served += 1
             self._tm.serving_requests_completed_total.inc()
             self._tm.serving_request_latency_seconds.observe(
@@ -404,6 +449,8 @@ class ContinuousLMServer:
         for _slot, sl in stranded:
             sl.req.error = f"server died: {reason}"
             sl.req.done.set()
+            tracing.async_end("serving.request", sl.req.rid,
+                              error=sl.req.error)
         self._tm.serving_slots_occupied.set(0)
         while True:
             try:
@@ -412,6 +459,7 @@ class ContinuousLMServer:
                 break
             req.error = f"server is dead: {reason}"
             req.done.set()
+            tracing.async_end("serving.request", req.rid, error=req.error)
             self._tm.serving_request_errors_total.inc()
         self._tm.serving_queue_depth.set(0)
 
@@ -437,6 +485,8 @@ class ContinuousLMServer:
         for _slot, sl in stranded:
             sl.req.error = "server closed mid-generation"
             sl.req.done.set()
+            tracing.async_end("serving.request", sl.req.rid,
+                              error=sl.req.error)
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -444,6 +494,7 @@ class ContinuousLMServer:
                 break
             req.error = "server closed before the request was dispatched"
             req.done.set()
+            tracing.async_end("serving.request", req.rid, error=req.error)
 
     def _serve_loop(self):
         while not self._stop.is_set():
@@ -470,7 +521,13 @@ class ContinuousLMServer:
             key = jax.random.fold_in(self._step_key, self._steps)
             try:
                 t_block = time.perf_counter()
-                with span("serving.decode_block", live=len(self._active)):
+                with span("serving.decode_block",
+                          live=len(self._active)) as sp:
+                    if tracing.is_enabled():
+                        # which requests this block advanced (rid linkage;
+                        # list built only when the tracer is on)
+                        sp.annotate(rids=[sl.req.rid
+                                          for sl in self._active.values()])
                     toks, self.buffers = self._step()(
                         self.params, self.buffers,
                         jnp.asarray(self._last_tok), key)
@@ -492,6 +549,7 @@ class ContinuousLMServer:
             self._tm.serving_token_latency_seconds.observe(
                 (time.perf_counter() - t_block) / self.decode_block)
             self._tm.serving_decode_blocks_total.inc()
+            sample_device_memory(self.registry)
             self._last_tok = toks[:, -1].astype(np.int32)
             eos = self.eos_id
             live_tokens = 0

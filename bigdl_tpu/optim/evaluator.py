@@ -21,6 +21,7 @@ from bigdl_tpu.dataset.base import (AbstractDataSet, LocalDataSet, MiniBatch,
 from bigdl_tpu.nn.module import Module, functional_apply
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 from bigdl_tpu.telemetry import get_registry, instruments, span
+from bigdl_tpu.telemetry.profiling import tracked_jit
 
 
 def _as_minibatch(item) -> MiniBatch:
@@ -99,7 +100,8 @@ def _evaluate_batches(fwd, params, buffers, batches, v_methods, cache):
                             for _, c in pairs])
             return av + vs, ac + cs
 
-        scorer = jax.jit(scorer_fn, donate_argnums=(4,))
+        scorer = tracked_jit(scorer_fn, site="eval.scorer",
+                             donate_argnums=(4,))
     acc = None
     n_batches = 0
     for item in batches:
@@ -168,12 +170,11 @@ class Evaluator:
         if getattr(self, "_fwd_jit", None) is None:
             model = self.model
 
-            @jax.jit
             def fwd(p, b, x):
                 out, _ = functional_apply(model, p, b, x, training=False)
                 return out
 
-            self._fwd_jit = fwd
+            self._fwd_jit = tracked_jit(fwd, site="eval.forward")
         return self._fwd_jit
 
     def test(self, dataset, v_methods: Sequence[ValidationMethod]

@@ -35,6 +35,8 @@ from bigdl_tpu.optim.validation import ValidationMethod
 from bigdl_tpu.resilience.preemption import (PreemptionHandler,
                                              TrainingPreempted)
 from bigdl_tpu.telemetry import get_registry, instruments, span
+from bigdl_tpu.telemetry import profiling
+from bigdl_tpu.telemetry.profiling import sample_device_memory, tracked_jit
 from bigdl_tpu.utils import file_io
 from bigdl_tpu.utils.rng import RandomGenerator
 from bigdl_tpu.utils.table import Table, T
@@ -447,6 +449,7 @@ class Optimizer:
             records=tm.train_records_total.labels(mode=mode),
             rps=tm.train_records_per_second.labels(mode=mode),
             compiles=tm.train_compiles_total.labels(mode=mode),
+            mfu=tm.train_mfu.labels(mode=mode),
             validation=tm.train_validation_seconds.labels(mode=mode))
         self._tm_cache = cached
         return cached
@@ -566,7 +569,10 @@ class LocalOptimizer(Optimizer):
                                                      params)
             return new_params, new_buf, new_opt_state, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        # compile flight recorder: counts/times every step compilation
+        # and yields the program's cost analysis — the FLOPs numerator of
+        # the live bigdl_train_mfu gauge (telemetry/profiling.py)
+        return tracked_jit(step, site="train.step", donate_argnums=(0, 1, 2))
 
     def _build_multi_step(self) -> Callable:
         """K fused iterations per dispatch (``set_steps_per_dispatch``):
@@ -593,7 +599,8 @@ class LocalOptimizer(Optimizer):
                 body, (params, buffers, opt_state), (keys, datas, labels))
             return p, b, o, losses
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        return tracked_jit(multi, site="train.multi_step",
+                           donate_argnums=(0, 1, 2))
 
     def _build_multi_step_cached(self) -> Callable:
         """K-fused dispatch over a device-resident dataset cache
@@ -622,7 +629,8 @@ class LocalOptimizer(Optimizer):
                 body, (params, buffers, opt_state), (keys, idx))
             return p, b, o, losses
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        return tracked_jit(multi, site="train.multi_step_cached",
+                           donate_argnums=(0, 1, 2))
 
     def _build_forward(self) -> Callable:
         model = self.model
@@ -631,7 +639,7 @@ class LocalOptimizer(Optimizer):
             out, _ = functional_apply(model, params, buffers, data, training=False)
             return out
 
-        return jax.jit(fwd)
+        return tracked_jit(fwd, site="train.forward")
 
     def optimize(self) -> Module:
         """Train with retry-from-checkpoint (reference
@@ -854,10 +862,27 @@ class LocalOptimizer(Optimizer):
                                   and last_done > p["t0"] else p["t0"])
             last_done = done
             iter_time = window_time / len(p["iters"])
-            if p["iters"][0]["neval"] == 1:
+            first_window = p["iters"][0]["neval"] == 1
+            if first_window:
                 # first step pays tracing+XLA compile (unless cached)
                 self.metrics.add("compile and first-step time", window_time)
                 tm.compiles.inc()
+            # live MFU: the dispatched program's cost-analysis FLOPs (one
+            # program ran the whole window, K iterations included) over
+            # the window wall-clock and the chip's peak — absent when the
+            # backend has no cost analysis or no known roof. The compile-
+            # bearing first window is SKIPPED: its wall-clock is mostly
+            # XLA, and publishing FLOPs/(compile+step) would trip any
+            # dashboard threshold at every (re)start.
+            fn = p.get("fn")
+            fn = getattr(fn, "tracked", fn)  # ZeRO-1 wraps its TrackedJit
+            if not first_window:
+                m = profiling.mfu(getattr(fn, "last_flops", None),
+                                  window_time)
+                if m is not None:
+                    tm.mfu.set(m)
+            # step-boundary device-memory watermark (no-op on CPU)
+            sample_device_memory()
             for meta, loss_f in zip(p["iters"], losses):
                 loss_f = float(loss_f)
                 throughput = meta["n_records"] / max(iter_time, 1e-9)
@@ -969,6 +994,7 @@ class LocalOptimizer(Optimizer):
                         jax.profiler.start_trace(pdir)
                         self._profiling_active = True
                 t0 = time.time()
+                used_fn = step  # which tracked program served the window
                 with span("train.dispatch", k=k):
                     if k == 1:
                         data, labels = self._place_batch(window[0])
@@ -987,6 +1013,7 @@ class LocalOptimizer(Optimizer):
                             # dispatch per window
                             src = window[0].source
                             idx = jnp.stack([b.idx for b in window])
+                            used_fn = multi_step_cached
                             params, buffers, opt_state, losses = \
                                 multi_step_cached(params, buffers,
                                                   opt_state, keys,
@@ -998,6 +1025,7 @@ class LocalOptimizer(Optimizer):
                                             for b in window])
                             ys = jnp.stack([jnp.asarray(b.labels)
                                             for b in window])
+                            used_fn = multi_step
                             params, buffers, opt_state, losses = multi_step(
                                 params, buffers, opt_state, keys, xs, ys)
                 # host time enqueueing the window (async; device compute
@@ -1023,7 +1051,8 @@ class LocalOptimizer(Optimizer):
                                   "epoch_records": epoch_records,
                                   "size": self.dataset.size(),
                                   "lr": lr_arr})
-                pending = {"losses": losses, "iters": iters, "t0": t0}
+                pending = {"losses": losses, "iters": iters, "t0": t0,
+                           "fn": used_fn}
                 if self._profiling_active and last_neval >= pstart + pn - 1:
                     jax.profiler.stop_trace()
                     self._profiling_active = False

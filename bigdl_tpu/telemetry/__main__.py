@@ -1,15 +1,21 @@
-"""Telemetry CLI: ``python -m bigdl_tpu.telemetry {metrics|trace} ...``
-(wrapped by ``scripts/bigdl-tpu.sh metrics|trace``).
+"""Telemetry CLI: ``python -m bigdl_tpu.telemetry
+{metrics|trace|scoreboard} ...`` (wrapped by ``scripts/bigdl-tpu.sh``).
 
-``metrics``  scrape a running server's ``/metrics`` (URL positional) and
-             print it; ``--selftest`` exercises the registry + exposition
-             pipeline in-process instead (CI smoke, no server needed).
-``trace``    validate a dumped Chrome trace_event JSON file and print a
-             per-span summary; ``--selftest`` records demo spans and
-             dumps a valid trace (to ``--out`` or stdout).
+``metrics``     scrape a running server's ``/metrics`` (URL positional)
+                and print it; ``--selftest`` exercises the registry +
+                exposition pipeline in-process instead (CI smoke).
+``trace``       validate a dumped Chrome trace_event JSON file and print
+                a per-span summary; ``--selftest`` records demo spans
+                and dumps a valid trace (to ``--out`` or stdout).
+``scoreboard``  the automated serving scoreboard
+                (``telemetry/scoreboard.py``): drive the seeded Zipf
+                workload in-process (``scoreboard``, needs jax), snapshot
+                a live server (``scoreboard scrape <url>``), or gate two
+                artifacts (``scoreboard diff <old> <new>`` — exit 1 on a
+                regression past the thresholds).
 
-Exit status: 0 ok, 1 invalid trace / failed scrape, 2 usage errors.
-jax-free: both subcommands run in milliseconds on a bare host.
+Exit status: 0 ok, 1 invalid trace / failed scrape / regression,
+2 usage errors. metrics/trace/scoreboard-diff are jax-free.
 """
 
 from __future__ import annotations
@@ -158,6 +164,73 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_scoreboard(args) -> int:
+    from bigdl_tpu.telemetry import scoreboard as sb
+
+    if args.mode == "diff":
+        if len(args.paths) != 2:
+            print("scoreboard diff: give exactly two artifact paths "
+                  "(old new)", file=sys.stderr)
+            return 2
+        try:
+            old = sb.load_artifact(args.paths[0])
+            new = sb.load_artifact(args.paths[1])
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"scoreboard diff: {e}", file=sys.stderr)
+            return 2
+        thresholds = {
+            "tok_s_drop": args.max_tok_drop,
+            "ttft_p50_rise": args.max_ttft_rise,
+            "ttft_p95_rise": args.max_ttft_rise,
+            "token_latency_rise": args.max_latency_rise,
+            "compiles_rise": args.max_compile_rise,
+            "peak_memory_rise": args.max_mem_rise,
+        }
+        regressions = sb.diff(old, new, thresholds)
+        if regressions:
+            print("scoreboard REGRESSIONS:", file=sys.stderr)
+            for r in regressions:
+                print(f"  {r}", file=sys.stderr)
+            return 1
+        print(f"scoreboard: no regressions across "
+              f"{len(new.get('rows', []))} row(s)")
+        return 0
+
+    if args.mode == "scrape":
+        if len(args.paths) != 1:
+            print("scoreboard scrape: give the server URL", file=sys.stderr)
+            return 2
+        try:
+            artifact = sb.scrape(args.paths[0], timeout=args.timeout)
+        except Exception as e:      # noqa: BLE001 — report, don't traceback
+            print(f"scoreboard scrape failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+    else:                           # run: drive the seeded workload
+        if args.paths:
+            print("scoreboard: the run mode takes no positional arguments "
+                  "(use 'diff'/'scrape' as the first)", file=sys.stderr)
+            return 2
+        cfg = sb.ScoreboardConfig(
+            slots=[int(s) for s in args.slots.split(",")],
+            requests=args.requests, clients=args.clients, seed=args.seed,
+            lmin=args.lmin, lmax=args.lmax, alpha=args.alpha,
+            max_new=args.max_new, vocab=args.vocab, embed=args.embed,
+            heads=args.heads, ffn=args.ffn, layers=args.layers,
+            timeout=args.timeout)
+        artifact = sb.run(cfg)
+    body = json.dumps(artifact, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(body + "\n")
+        print(f"wrote {args.out}")
+    else:
+        print(body)
+    if args.markdown:
+        print(sb.render_markdown(artifact))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m bigdl_tpu.telemetry",
@@ -189,6 +262,53 @@ def main(argv: Optional[List[str]] = None) -> int:
     pt.add_argument("--selftest", action="store_true",
                     help="record demo spans and dump a valid trace")
     pt.set_defaults(fn=cmd_trace)
+
+    ps = sub.add_parser(
+        "scoreboard",
+        help="serving scoreboard: run the seeded workload, scrape a live "
+             "server, or diff two artifacts (docs/OBSERVABILITY.md)")
+    ps.add_argument("mode", nargs="?", default="run",
+                    choices=("run", "diff", "scrape"),
+                    help="run (default): drive the workload in-process; "
+                         "diff OLD NEW: regression gate; scrape URL: "
+                         "snapshot a live /metrics")
+    ps.add_argument("paths", nargs="*", default=[],
+                    help="diff: two artifact files; scrape: server URL")
+    ps.add_argument("--slots", default="8,16,32",
+                    help="comma-separated slot counts, one row each")
+    ps.add_argument("--requests", type=int, default=48,
+                    help="requests per slot count")
+    ps.add_argument("--clients", type=int, default=8,
+                    help="concurrent submitter threads")
+    ps.add_argument("--seed", type=int, default=0)
+    ps.add_argument("--lmin", type=int, default=4)
+    ps.add_argument("--lmax", type=int, default=24)
+    ps.add_argument("--alpha", type=float, default=1.1,
+                    help="Zipf exponent over the prompt-length ranks")
+    ps.add_argument("--max-new", type=int, default=16, dest="max_new")
+    ps.add_argument("--vocab", type=int, default=256)
+    ps.add_argument("--embed", type=int, default=32)
+    ps.add_argument("--heads", type=int, default=2)
+    ps.add_argument("--ffn", type=int, default=64)
+    ps.add_argument("--layers", type=int, default=2)
+    ps.add_argument("--timeout", type=float, default=600.0)
+    ps.add_argument("--out", default="",
+                    help="write the JSON artifact here (default: stdout)")
+    ps.add_argument("--markdown", action="store_true",
+                    help="also print the PERF.md table")
+    ps.add_argument("--max-tok-drop", type=float, dest="max_tok_drop",
+                    default=0.15, help="diff: allowed tok/s drop fraction")
+    ps.add_argument("--max-ttft-rise", type=float, dest="max_ttft_rise",
+                    default=0.30, help="diff: allowed TTFT rise fraction")
+    ps.add_argument("--max-latency-rise", type=float,
+                    dest="max_latency_rise", default=0.30,
+                    help="diff: allowed per-token latency rise fraction")
+    ps.add_argument("--max-compile-rise", type=float,
+                    dest="max_compile_rise", default=0,
+                    help="diff: allowed absolute extra compiles")
+    ps.add_argument("--max-mem-rise", type=float, dest="max_mem_rise",
+                    default=0.10, help="diff: allowed peak-memory rise")
+    ps.set_defaults(fn=cmd_scoreboard)
 
     args = parser.parse_args(argv)
     return args.fn(args)

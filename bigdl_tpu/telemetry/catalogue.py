@@ -124,6 +124,42 @@ METRIC_SPECS: List[MetricSpec] = [
                "Counted once per eager call / once per TRACE under jit "
                "(the decision runs at trace time), and warned once per "
                "shape."),
+    # ---- compile flight recorder (telemetry/profiling.py tracked_jit)
+    MetricSpec("bigdl_compiles_total", "counter",
+               "XLA program compilations recorded by tracked_jit — one "
+               "per new (site, abstract arg signature).", ("site",)),
+    MetricSpec("bigdl_compile_seconds", "histogram",
+               "Wall-clock of one tracked_jit trace+lower+compile.",
+               ("site",), DEFAULT_LATENCY_BUCKETS + (60.0, 120.0)),
+    MetricSpec("bigdl_program_flops", "gauge",
+               "cost_analysis FLOPs of the site's most recently compiled "
+               "program (per execution of that program).", ("site",)),
+    MetricSpec("bigdl_program_bytes_accessed", "gauge",
+               "cost_analysis HBM bytes accessed per execution of the "
+               "site's most recently compiled program.", ("site",)),
+    MetricSpec("bigdl_program_temp_bytes", "gauge",
+               "memory_analysis temp (scratch) allocation of the site's "
+               "most recently compiled program.", ("site",)),
+    MetricSpec("bigdl_program_output_bytes", "gauge",
+               "memory_analysis output allocation of the site's most "
+               "recently compiled program.", ("site",)),
+    MetricSpec("bigdl_compile_cache_evictions_total", "counter",
+               "Compiled programs dropped oldest-first from a bounded "
+               "program cache (tracked_jit executables, the serving "
+               "prefill family, generate() signature family).", ("site",)),
+    MetricSpec("bigdl_train_mfu", "gauge",
+               "Live model-FLOPs utilization of the training loop: "
+               "cost-analysis FLOPs per dispatch / dispatch wall seconds "
+               "/ peak chip FLOP/s (absent when the backend reports no "
+               "cost analysis or the peak is unknown — override with "
+               "BIGDL_TPU_PEAK_FLOPS).", ("mode",)),
+    MetricSpec("bigdl_device_memory_bytes", "gauge",
+               "Device 0 bytes currently allocated (sampled at step "
+               "boundaries and slot admission; absent on runtimes "
+               "without allocator stats, e.g. CPU)."),
+    MetricSpec("bigdl_device_memory_peak_bytes", "gauge",
+               "Device 0 peak-bytes-in-use watermark (same sampling "
+               "points as bigdl_device_memory_bytes)."),
     # ---- legacy bridge (optim/metrics.py)
     MetricSpec("bigdl_legacy_metric", "gauge",
                "Legacy optim.Metrics counters bridged onto the registry "
@@ -138,12 +174,22 @@ METRIC_SPECS: List[MetricSpec] = [
 
 #: Span inventory (tracing.span names) with where they fire.
 SPAN_SPECS: List[Tuple[str, str]] = [
+    ("serving.request", "Async lifecycle of ONE continuous-serving "
+     "request (Chrome async events sharing the request id): begins at "
+     "submit, instants at admission, ends at completion/failure — with "
+     "serving.queue_wait/prefill/insert carrying the same rid arg, a "
+     "single dump reconstructs the whole journey."),
+    ("serving.queue_wait", "Retrodicted span from a request's submit to "
+     "the start of its admission (queue-wait attribution; rid arg links "
+     "it to its serving.request lifecycle)."),
     ("serving.prefill", "Out-of-band b=1 prompt prefill + admission "
      "sampling (models/serving.py _admit)."),
     ("serving.insert", "Jitted cache scatter of a prefilled request into "
      "a free slot row."),
     ("serving.decode_block", "One jitted decode_block-token step over all "
      "slots."),
+    ("lmserver.request", "Async lifecycle of one bucketed-server request "
+     "(submit -> batch dispatch -> completion) under the request id."),
     ("lmserver.gather", "Batcher wait assembling one same-length batch."),
     ("lmserver.decode_batch", "One batched prefill+decode program "
      "(models/lm_server.py)."),
@@ -155,6 +201,9 @@ SPAN_SPECS: List[Tuple[str, str]] = [
      "state + RESUME marker (optim/optimizer.py)."),
     ("eval.batches", "One evaluate_batches call (all batches + the final "
      "device->host merge)."),
+    ("profiling.compile", "One tracked_jit compilation of a new "
+     "(site, signature) — trace+lower+compile wall time "
+     "(telemetry/profiling.py)."),
 ]
 
 

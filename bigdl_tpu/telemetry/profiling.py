@@ -1,0 +1,396 @@
+"""Compile flight recorder: ``tracked_jit`` — a ``jax.jit`` wrapper that
+ATTRIBUTES cost instead of just spending it.
+
+PR 5's telemetry records durations; nothing said which jitted programs
+compiled, how long each compilation took, what FLOPs/HBM bytes a program
+accounts for, or what memory it holds. This module closes that gap with
+one primitive every jitted site adopts (``optim/optimizer.py``,
+``parallel/distri_optimizer.py``, ``models/serving.py``,
+``models/generation.py``, ``optim/evaluator.py``, ``bench.py``):
+
+    step = tracked_jit(step_fn, site="train.step", donate_argnums=(0, 1, 2))
+
+Mechanics: the wrapper keys calls by the ABSTRACT argument signature
+(pytree structure + per-leaf shape/dtype/sharding — exactly what XLA
+specializes on) and compiles new signatures through the AOT path
+(``jitted.lower(*args).compile()``), so each compilation happens exactly
+once, is timed on the wall clock, and yields the compiled executable's
+``cost_analysis()`` (FLOPs, bytes accessed) and ``memory_analysis()``
+(temp/output bytes) BEFORE the first execution. Repeat calls dispatch the
+cached executable directly. One flight-recorder event per compilation
+lands in:
+
+- ``bigdl_compiles_total{site}`` / ``bigdl_compile_seconds{site}``;
+- per-site last-program cost gauges ``bigdl_program_flops{site}``,
+  ``bigdl_program_bytes_accessed{site}``, ``bigdl_program_temp_bytes``
+  ``/_output_bytes{site}``;
+- a ``profiling.compile`` span (site + signature + seconds) when the
+  tracer is on, so compile storms are visible inside a Chrome trace.
+
+Cost fields are present-or-None: backends that cannot answer (some CPU
+builds, PJRT plugins without analysis support) degrade to counting and
+timing only — never to an exception on the serving path. Any AOT failure
+falls back to the plain jitted call for that signature, still counted.
+
+The per-signature executable cache is bounded (``cache_size``) with
+OLDEST-FIRST SINGLE-ENTRY eviction — evicting one program on overflow
+instead of wiping the cache, so live signatures under mixed traffic do
+not all recompile at once (the clear-at-cap eviction storm this PR fixes
+in the serving prefill and generate() caches). Evictions count in
+``bigdl_compile_cache_evictions_total{site}``.
+
+jax-free at import (the telemetry package contract): jax loads on first
+``tracked_jit`` construction.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from bigdl_tpu.telemetry.registry import MetricsRegistry, get_registry
+from bigdl_tpu.telemetry.tracing import span
+
+__all__ = ["tracked_jit", "TrackedJit", "CompileEvent", "peak_flops",
+           "sample_device_memory", "DEFAULT_CACHE_SIZE"]
+
+#: Default retained-executable bound per tracked site. Generous for
+#: steady-state sites (a training loop has ONE signature); the serving
+#: prefill passes its own cap (= the documented _PREFILL_CACHE_CAP).
+DEFAULT_CACHE_SIZE = 64
+
+
+class CompileEvent:
+    """One recorded compilation: what compiled, how long, what it costs."""
+
+    __slots__ = ("site", "signature", "seconds", "flops", "bytes_accessed",
+                 "temp_bytes", "output_bytes", "argument_bytes")
+
+    def __init__(self, site: str, signature: str, seconds: float,
+                 flops: Optional[float] = None,
+                 bytes_accessed: Optional[float] = None,
+                 temp_bytes: Optional[int] = None,
+                 output_bytes: Optional[int] = None,
+                 argument_bytes: Optional[int] = None):
+        self.site = site
+        self.signature = signature
+        self.seconds = seconds
+        self.flops = flops
+        self.bytes_accessed = bytes_accessed
+        self.temp_bytes = temp_bytes
+        self.output_bytes = output_bytes
+        self.argument_bytes = argument_bytes
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+def _leaf_key(x) -> Tuple:
+    """Hashable abstract descriptor of one argument leaf. jax arrays key
+    on (shape, dtype, weak_type, sharding) — sharding included because a
+    compiled executable is specialized to its input layout (a mesh-
+    committed and an uncommitted array of the same shape need different
+    programs). Non-array leaves key on their type: a Python scalar traces
+    as a weak-typed 0-d input, so its VALUE does not split programs.
+
+    TRACER leaves raise TypeError: a tracked fn called inside another
+    trace (the eval scorer calls the tracked forward) must inline through
+    the plain jit wrapper — a compiled executable cannot consume
+    tracers. ``__call__`` catches and dispatches accordingly."""
+    import jax
+    if isinstance(x, jax.core.Tracer):
+        raise TypeError("tracer argument: dispatch through jax.jit")
+    aval = getattr(x, "aval", None)
+    if aval is not None:                       # jax.Array fast path
+        return (aval.shape, str(aval.dtype), bool(aval.weak_type),
+                getattr(x, "sharding", None))
+    shape = getattr(x, "shape", None)
+    if shape is not None and hasattr(x, "dtype"):   # numpy array
+        return (tuple(shape), str(x.dtype), False, None)
+    return (type(x),)
+
+
+def _cost_number(analysis, key: str) -> Optional[float]:
+    """Pull one scalar out of ``Compiled.cost_analysis()`` across the API
+    shapes jax has shipped: a dict, or a list with one dict per
+    computation (sum them — a multi-computation program spends all of
+    them per call)."""
+    if analysis is None:
+        return None
+    if isinstance(analysis, dict):
+        analysis = [analysis]
+    total, seen = 0.0, False
+    try:
+        for entry in analysis:
+            v = entry.get(key)
+            if v is not None and v >= 0:
+                total += float(v)
+                seen = True
+    except (AttributeError, TypeError):
+        return None
+    return total if seen else None
+
+
+class TrackedJit:
+    """``jax.jit`` with a compile flight recorder (see module docstring).
+
+    NOT a drop-in for every jit feature: static_argnums/argnames are
+    passed through to the underlying jit, but the signature key treats
+    Python scalars by TYPE, so static-arg call families should keep using
+    plain ``jax.jit`` (graftlint JG013 already polices those). All
+    adopted sites in this repo take array pytrees only.
+    """
+
+    def __init__(self, fn: Callable, *, site: str,
+                 registry: Optional[MetricsRegistry] = None,
+                 cache_size: int = DEFAULT_CACHE_SIZE,
+                 **jit_kwargs):
+        import jax
+
+        from bigdl_tpu.telemetry.catalogue import instruments
+        self.site = site
+        self.cache_size = max(1, int(cache_size))
+        self._jitted = jax.jit(fn, **jit_kwargs)
+        self._registry = registry if registry is not None else get_registry()
+        self._tm = instruments(self._registry)
+        # signature -> compiled executable (None = AOT unsupported for
+        # that signature; dispatch through the plain jitted wrapper)
+        self._programs: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self.events: list = []            # CompileEvent, oldest first
+        self.last_event: Optional[CompileEvent] = None
+        self.compiles = 0
+
+    # ------------------------------------------------------------- recording
+    @property
+    def last_flops(self) -> Optional[float]:
+        ev = self.last_event
+        return ev.flops if ev is not None else None
+
+    def _signature(self, args) -> Tuple:
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return (treedef, tuple(_leaf_key(x) for x in leaves))
+
+    def _describe(self, args) -> str:
+        """Human-readable shape signature for the event/span (kept terse:
+        leaf count + first few leaf shapes)."""
+        import jax
+        leaves = jax.tree_util.tree_leaves(args)
+        shapes = []
+        for x in leaves[:4]:
+            shapes.append("x".join(str(d) for d in getattr(x, "shape", ()))
+                          or "scalar")
+        extra = f"+{len(leaves) - 4}" if len(leaves) > 4 else ""
+        return f"{len(leaves)} leaves ({','.join(shapes)}{extra})"
+
+    def _record(self, seconds: float, compiled, signature: str) -> None:
+        flops = bytes_accessed = temp = outb = argb = None
+        if compiled is not None:
+            try:
+                analysis = compiled.cost_analysis()
+                flops = _cost_number(analysis, "flops")
+                bytes_accessed = _cost_number(analysis, "bytes accessed")
+            except Exception:       # noqa: BLE001 — analysis is best-effort
+                pass
+            try:
+                mem = compiled.memory_analysis()
+                temp = int(getattr(mem, "temp_size_in_bytes", None))
+                outb = int(getattr(mem, "output_size_in_bytes", None))
+                argb = int(getattr(mem, "argument_size_in_bytes", None))
+            except Exception:       # noqa: BLE001
+                pass
+        ev = CompileEvent(self.site, signature, seconds, flops,
+                          bytes_accessed, temp, outb, argb)
+        self.events.append(ev)
+        self.last_event = ev
+        self.compiles += 1
+        site = self.site
+        self._tm.compiles_total.labels(site=site).inc()
+        self._tm.compile_seconds.labels(site=site).observe(seconds)
+        if flops is not None:
+            self._tm.program_flops.labels(site=site).set(flops)
+        if bytes_accessed is not None:
+            self._tm.program_bytes_accessed.labels(site=site).set(
+                bytes_accessed)
+        if temp is not None:
+            self._tm.program_temp_bytes.labels(site=site).set(temp)
+        if outb is not None:
+            self._tm.program_output_bytes.labels(site=site).set(outb)
+
+    # ------------------------------------------------------------- dispatch
+    def __call__(self, *args):
+        programs = self._programs
+        try:
+            key = self._signature(args)
+        except TypeError:         # unhashable leaf metadata: bypass tracking
+            return self._jitted(*args)
+        compiled = programs.get(key, _MISS)
+        if compiled is _MISS:
+            compiled = self._compile(key, args)
+        elif compiled is None:    # known-unsupported signature
+            return self._jitted(*args)
+        else:
+            programs.move_to_end(key)
+        return compiled(*args)
+
+    def _compile(self, key, args):
+        """AOT-compile a new signature, record the event, bound the cache.
+        Returns the executable, or falls back to (and returns the result
+        semantics of) the plain jitted path by caching ``None``."""
+        desc = self._describe(args)
+        t0 = time.perf_counter()
+        try:
+            with span("profiling.compile", site=self.site, signature=desc):
+                compiled = self._jitted.lower(*args).compile()
+        except Exception:       # noqa: BLE001 — AOT unsupported here: the
+            # plain jit call must still work (and still counts: its first
+            # dispatch IS the compile, timed around the call)
+            self._programs[key] = None
+            result = self._jitted(*args)
+            self._record(time.perf_counter() - t0, None, desc)
+            self._evict()
+            # hand the caller the already-computed result through the
+            # normal `compiled(*args)` return path
+            return _Precomputed(result)
+        self._record(time.perf_counter() - t0, compiled, desc)
+        self._programs[key] = compiled
+        self._evict()
+        return compiled
+
+    def _evict(self) -> None:
+        while len(self._programs) > self.cache_size:
+            # oldest-first SINGLE-entry eviction — never clear-at-cap
+            # (evicting everything forces every live signature to
+            # recompile immediately; see module docstring)
+            self._programs.popitem(last=False)
+            self._tm.compile_cache_evictions_total.labels(
+                site=self.site).inc()
+
+    # -------------------------------------------------------------- AOT API
+    def lower(self, *args, **kwargs):
+        """Delegate to the underlying ``jax.jit`` wrapper (HLO-contract
+        tests lower and inspect programs without executing them)."""
+        return self._jitted.lower(*args, **kwargs)
+
+    def __repr__(self) -> str:
+        return (f"TrackedJit(site={self.site!r}, compiles={self.compiles}, "
+                f"cached={len(self._programs)})")
+
+
+class _Precomputed:
+    """Adapter so ``_compile``'s fallback path can return 'an executable'
+    whose one pending call result is already known."""
+
+    __slots__ = ("_result",)
+
+    def __init__(self, result):
+        self._result = result
+
+    def __call__(self, *args):
+        return self._result
+
+
+_MISS = object()
+
+
+def tracked_jit(fn: Callable, *, site: str,
+                registry: Optional[MetricsRegistry] = None,
+                cache_size: int = DEFAULT_CACHE_SIZE,
+                **jit_kwargs) -> TrackedJit:
+    """Wrap ``fn`` as a compile-tracked jit (see :class:`TrackedJit`)."""
+    return TrackedJit(fn, site=site, registry=registry,
+                      cache_size=cache_size, **jit_kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Peak-FLOPs model + MFU
+# ---------------------------------------------------------------------------
+
+# bf16 peak FLOP/s by device kind substring (the roofline numerators the
+# PERF.md analyses already use; first match wins)
+_PEAK_BY_KIND = (
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+    ("v6", 918e12),
+)
+
+_peak_cache: Dict[str, Optional[float]] = {}
+
+
+def peak_flops() -> Optional[float]:
+    """Per-chip peak FLOP/s for MFU computation, or None when unknown.
+
+    ``BIGDL_TPU_PEAK_FLOPS`` overrides (any backend — the only way to get
+    MFU on CPU or an unrecognized accelerator); otherwise the TPU device
+    kind maps through the table above. Unknown = None: an MFU computed
+    against a made-up roof is worse than no MFU."""
+    env = os.environ.get("BIGDL_TPU_PEAK_FLOPS", "")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if "kind" not in _peak_cache:
+        kind = ""
+        try:
+            import jax
+            dev = jax.local_devices()[0]
+            if dev.platform == "tpu":
+                kind = getattr(dev, "device_kind", "").lower()
+        except Exception:       # noqa: BLE001 — no backend, no roof
+            kind = ""
+        _peak_cache["kind"] = next(
+            (f for sub, f in _PEAK_BY_KIND if sub in kind), None)
+    return _peak_cache["kind"]
+
+
+def mfu(flops_per_step: Optional[float],
+        step_seconds: float) -> Optional[float]:
+    """Model-FLOPs utilization: cost-analysis FLOPs / wall seconds /
+    peak. None whenever either input is unknown."""
+    peak = peak_flops()
+    if not flops_per_step or not step_seconds or not peak:
+        return None
+    return flops_per_step / step_seconds / peak
+
+
+# ---------------------------------------------------------------------------
+# Device-memory watermark
+# ---------------------------------------------------------------------------
+
+_mem_unsupported = False
+
+
+def sample_device_memory(registry: Optional[MetricsRegistry] = None) -> \
+        Optional[int]:
+    """Sample device 0's memory stats into the
+    ``bigdl_device_memory_bytes`` / ``_peak_bytes`` gauges; returns the
+    peak, or None where the runtime has no allocator stats (CPU). Called
+    at step boundaries and slot admission — cheap (one PJRT call), and a
+    no-op forever after the first unsupported answer."""
+    global _mem_unsupported
+    if _mem_unsupported:
+        return None
+    stats = None
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:       # noqa: BLE001 — absent backend == unsupported
+        stats = None
+    if not stats:
+        _mem_unsupported = True
+        return None
+    from bigdl_tpu.telemetry.catalogue import instruments
+    tm = instruments(registry if registry is not None else get_registry())
+    in_use = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use")
+    if in_use is not None:
+        tm.device_memory_bytes.set(in_use)
+    if peak is not None:
+        tm.device_memory_peak_bytes.set(peak)
+    return peak

@@ -1,0 +1,440 @@
+"""Automated serving scoreboard (ROADMAP #1's measurement half).
+
+Drives a SEEDED Zipf mixed-length prompt workload against a live
+``ContinuousLMServer`` per slot count (default slots ∈ {8, 16, 32}),
+aggregates the serving SLO surface out of the telemetry registry —
+tok/s, p50/p95 TTFT, per-token latency, compile counts from the PR-14
+flight recorder, peak device memory — into a JSON artifact plus the
+PERF.md markdown table, and diffs two artifacts with configurable
+regression thresholds (nonzero exit = regression), so the scoreboard is
+a CI gate and not just a report.
+
+Three modes behind ``python -m bigdl_tpu.telemetry scoreboard`` /
+``scripts/bigdl-tpu.sh scoreboard``:
+
+- **run** (default): build a small LM (or the configured shape), run the
+  workload per slot count against an in-process server, write the
+  artifact (+ markdown with ``--markdown``);
+- **scrape <url>**: snapshot an EXISTING server's ``/metrics`` into a
+  one-row artifact (no jax, no model — operator-side);
+- **diff <old> <new>**: compare artifacts row-by-row (matched on slots)
+  and exit 1 past the thresholds.
+
+Workload determinism: prompt lengths are drawn from a Zipf-weighted
+rank distribution over [lmin, lmax] and token ids uniformly from the
+vocab, all under one ``random.Random(seed)`` — two runs of the same
+config submit byte-identical prompts in the same order.
+
+jax-free at import (scrape/diff must run on a bare host); the run mode
+lazy-imports the model/server stack.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["ScoreboardConfig", "zipf_lengths", "make_prompts", "run",
+           "scrape", "render_markdown", "diff", "DEFAULT_THRESHOLDS",
+           "quantile_from_snapshot"]
+
+SCHEMA = 1
+DEFAULT_SLOTS = (8, 16, 32)
+
+#: Regression gates for ``diff`` (fractions of the OLD value; compiles
+#: is an absolute count allowance). Loose enough for run-to-run noise on
+#: a shared host, tight enough that an eviction storm or a lost kernel
+#: cannot hide.
+DEFAULT_THRESHOLDS: Dict[str, float] = {
+    "tok_s_drop": 0.15,          # throughput may drop <= 15%
+    "ttft_p50_rise": 0.30,
+    "ttft_p95_rise": 0.30,
+    "token_latency_rise": 0.30,
+    "compiles_rise": 0,          # absolute extra programs allowed
+    "peak_memory_rise": 0.10,
+}
+
+
+class ScoreboardConfig:
+    """Workload + model shape for the run mode (defaults are sized to
+    produce a meaningful mixed-length compile profile on one chip — or
+    CPU — in minutes)."""
+
+    def __init__(self, slots: Sequence[int] = DEFAULT_SLOTS,
+                 requests: int = 48, clients: int = 8, seed: int = 0,
+                 lmin: int = 4, lmax: int = 24, alpha: float = 1.1,
+                 max_new: int = 16, decode_block: int = 4,
+                 vocab: int = 256, embed: int = 32, heads: int = 2,
+                 ffn: int = 64, layers: int = 2,
+                 timeout: float = 600.0):
+        self.slots = [int(s) for s in slots]
+        self.requests = int(requests)
+        self.clients = max(1, int(clients))
+        self.seed = int(seed)
+        self.lmin, self.lmax = int(lmin), int(lmax)
+        self.alpha = float(alpha)
+        self.max_new = int(max_new)
+        self.decode_block = int(decode_block)
+        self.vocab = int(vocab)
+        self.embed, self.heads = int(embed), int(heads)
+        self.ffn, self.layers = int(ffn), int(layers)
+        self.timeout = float(timeout)
+        self.max_len = self.lmax + self.max_new + 8
+
+    def workload_dict(self) -> dict:
+        return {"requests": self.requests, "clients": self.clients,
+                "seed": self.seed, "zipf": {"lmin": self.lmin,
+                                            "lmax": self.lmax,
+                                            "alpha": self.alpha},
+                "max_new": self.max_new,
+                "model": {"vocab": self.vocab, "embed": self.embed,
+                          "heads": self.heads, "ffn": self.ffn,
+                          "layers": self.layers}}
+
+
+def zipf_lengths(n: int, *, seed: int, lmin: int, lmax: int,
+                 alpha: float = 1.1) -> List[int]:
+    """``n`` prompt lengths: rank r of the shuffled [lmin, lmax] length
+    set is drawn with probability ∝ r^-alpha — a few lengths dominate
+    (real traffic), but the tail keeps minting NEW lengths (the compile-
+    storm trigger the scoreboard exists to measure). Deterministic under
+    ``seed``."""
+    if lmax < lmin:
+        raise ValueError(f"lmax {lmax} < lmin {lmin}")
+    rng = random.Random(seed)
+    lengths = list(range(lmin, lmax + 1))
+    rng.shuffle(lengths)                 # rank -> length is seed-dependent
+    weights = [1.0 / (r + 1) ** alpha for r in range(len(lengths))]
+    return rng.choices(lengths, weights=weights, k=n)
+
+
+def make_prompts(cfg: ScoreboardConfig) -> List[List[int]]:
+    """The seeded workload: one 1-based id list per request."""
+    rng = random.Random(cfg.seed + 1)
+    out = []
+    for ln in zipf_lengths(cfg.requests, seed=cfg.seed, lmin=cfg.lmin,
+                           lmax=cfg.lmax, alpha=cfg.alpha):
+        out.append([rng.randint(1, cfg.vocab) for _ in range(ln)])
+    return out
+
+
+def quantile_from_snapshot(snap: dict, q: float) -> Optional[float]:
+    """Bucket-estimated quantile (upper bound of the bucket holding it)
+    from a registry ``Histogram.snapshot()``; None on empty."""
+    count = snap["count"]
+    if not count:
+        return None
+    target = q * count
+    for bound, cum in snap["buckets"]:
+        if cum >= target:
+            return float(bound)
+    return float(snap["buckets"][-1][0]) if snap["buckets"] else None
+
+
+def _build_model(cfg: ScoreboardConfig):
+    from bigdl_tpu.models import transformer
+    from bigdl_tpu.utils.rng import manual_seed
+    manual_seed(cfg.seed + 17)
+    return transformer.build_lm(cfg.vocab, cfg.embed, cfg.heads, cfg.ffn,
+                                num_layers=cfg.layers, max_len=cfg.max_len,
+                                rope=True, norm="rms")
+
+
+def _drive_one(cfg: ScoreboardConfig, slots: int) -> dict:
+    """One scoreboard row: a fresh model + server + PRIVATE registry (so
+    compile counts and latency histograms belong to THIS run), the full
+    seeded workload, aggregation from the registry."""
+    from bigdl_tpu.models.serving import ContinuousLMServer
+    from bigdl_tpu.telemetry import MetricsRegistry, instruments
+    from bigdl_tpu.telemetry.profiling import sample_device_memory
+    registry = MetricsRegistry()
+    tm = instruments(registry)
+    # the PJRT peak-bytes watermark is PROCESS-lifetime monotonic: a row
+    # may only claim a peak it raised itself, else the slots=8 run's
+    # high-water mark would be reported for every later row too
+    peak_before = sample_device_memory(registry)
+    model = _build_model(cfg)
+    server = ContinuousLMServer(model, slots=slots, max_len=cfg.max_len,
+                                decode_block=cfg.decode_block, greedy=True,
+                                max_new_tokens=cfg.max_new,
+                                seed=cfg.seed, registry=registry)
+    prompts = make_prompts(cfg)
+    errors: List[str] = []
+    lock = threading.Lock()
+    cursor = {"i": 0}
+
+    def client():
+        while True:
+            with lock:
+                i = cursor["i"]
+                if i >= len(prompts):
+                    return
+                cursor["i"] = i + 1
+            try:
+                server.submit(prompts[i], max_new_tokens=cfg.max_new,
+                              timeout=cfg.timeout)
+            except Exception as e:      # noqa: BLE001 — a failed request
+                # is a row-level fact, not a scoreboard crash
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    try:
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(min(cfg.clients, len(prompts)))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+    finally:
+        server.close()
+
+    ttft = tm.serving_ttft_seconds.labels().snapshot()
+    tok = tm.serving_token_latency_seconds.labels().snapshot()
+    compiles = sum(child.value
+                   for _, child in tm.compiles_total.children())
+    evictions = sum(child.value
+                    for _, child in
+                    tm.compile_cache_evictions_total.children())
+    compile_seconds = sum(
+        child.sum for _, child in tm.compile_seconds.children())
+    peak_mem = tm.device_memory_peak_bytes.value or None
+    if peak_mem is not None and peak_before is not None \
+            and peak_mem <= peak_before:
+        peak_mem = None     # watermark set by an EARLIER row: unknown here
+    tokens = tm.serving_tokens_total.value
+    return {
+        "slots": slots,
+        "requests": len(prompts),
+        "failed": len(errors),
+        "wall_s": round(wall, 3),
+        "tok_s": round(tokens / wall, 2) if wall > 0 else 0.0,
+        "ttft_p50_s": quantile_from_snapshot(ttft, 0.5),
+        "ttft_p95_s": quantile_from_snapshot(ttft, 0.95),
+        "token_latency_s": (round(tok["sum"] / tok["count"], 6)
+                            if tok["count"] else None),
+        "compiles": int(compiles),
+        "compile_seconds": round(compile_seconds, 3),
+        "cache_evictions": int(evictions),
+        "peak_memory_bytes": (int(peak_mem)
+                              if peak_mem is not None else None),
+        "errors": errors[:5],
+    }
+
+
+def run(cfg: ScoreboardConfig) -> dict:
+    """The full artifact: one row per configured slot count."""
+    import jax
+    backend = jax.default_backend()
+    rows = [_drive_one(cfg, s) for s in cfg.slots]
+    return {"schema": SCHEMA, "kind": "bigdl_tpu_serving_scoreboard",
+            "backend": backend, "workload": cfg.workload_dict(),
+            "rows": rows}
+
+
+# ---------------------------------------------------------------------------
+# Scrape mode: one row out of a live /metrics endpoint
+# ---------------------------------------------------------------------------
+
+def _parse_prometheus(text: str) -> Tuple[Dict[str, float],
+                                          Dict[str, dict]]:
+    """Minimal parser for OUR exposition: plain and labeled samples sum
+    into ``values[name]``; ``_bucket``/``_sum``/``_count`` triples build
+    ``hists[name]`` snapshots shaped like ``Histogram.snapshot()``.
+
+    A LABELED family exposes one series per label set
+    (``bigdl_compile_seconds_sum{site="serving.prefill"}`` next to
+    ``{site="serving.step"}``); everything ACCUMULATES across label
+    sets — sums, counts, and per-bound bucket counts (all children of
+    one family share the same bounds, and a sum of cumulative counts is
+    the cumulative count of the merged distribution)."""
+    values: Dict[str, float] = {}
+    buckets: Dict[str, Dict[float, float]] = {}
+    hists: Dict[str, dict] = {}
+    sample = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([0-9.eE+-]+|NaN)$")
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = sample.match(line.strip())
+        if not m:
+            continue
+        name, labels, val = m.group(1), m.group(2) or "", float(m.group(3))
+        if name.endswith("_bucket"):
+            base = name[:-len("_bucket")]
+            le = re.search(r'le="([^"]+)"', labels)
+            if le:
+                bound = (float("inf") if le.group(1) == "+Inf"
+                         else float(le.group(1)))
+                by_bound = buckets.setdefault(base, {})
+                by_bound[bound] = by_bound.get(bound, 0.0) + val
+            continue
+        if name.endswith("_sum"):
+            h = hists.setdefault(name[:-4], {})
+            h["sum"] = h.get("sum", 0.0) + val
+            continue
+        if name.endswith("_count"):
+            h = hists.setdefault(name[:-6], {})
+            h["count"] = h.get("count", 0) + int(val)
+            continue
+        values[name] = values.get(name, 0.0) + val
+    for base, by_bound in buckets.items():
+        h = hists.setdefault(base, {})
+        h["buckets"] = sorted((b, c) for b, c in by_bound.items()
+                              if b != float("inf"))
+        h["inf"] = by_bound.get(float("inf"), h.get("count", 0))
+        h.setdefault("count", int(h["inf"]))
+        h.setdefault("sum", 0.0)
+    return values, hists
+
+
+def scrape(url: str, timeout: float = 5.0) -> dict:
+    """One-row artifact from a LIVE server's /metrics (operator mode: no
+    jax, no model — whatever the server accumulated since boot)."""
+    import urllib.request
+    if "://" not in url:
+        url = "http://" + url
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        text = resp.read().decode("utf-8", errors="replace")
+    values, hists = _parse_prometheus(text)
+    ttft = hists.get("bigdl_serving_ttft_seconds",
+                     {"buckets": [], "count": 0, "sum": 0.0, "inf": 0})
+    tok = hists.get("bigdl_serving_token_latency_seconds",
+                    {"buckets": [], "count": 0, "sum": 0.0, "inf": 0})
+    peak = values.get("bigdl_device_memory_peak_bytes")
+    row = {
+        "slots": int(values.get("bigdl_serving_slots_total", 0)),
+        "requests": int(values.get(
+            "bigdl_serving_requests_completed_total", 0)),
+        "failed": int(values.get("bigdl_serving_request_errors_total", 0)),
+        "wall_s": None,              # a scrape has no workload wall-clock
+        "tok_s": None,
+        "tokens": int(values.get("bigdl_serving_tokens_total", 0)),
+        "ttft_p50_s": quantile_from_snapshot(ttft, 0.5),
+        "ttft_p95_s": quantile_from_snapshot(ttft, 0.95),
+        "token_latency_s": (round(tok["sum"] / tok["count"], 6)
+                            if tok.get("count") else None),
+        "compiles": int(values.get("bigdl_compiles_total", 0)),
+        "compile_seconds": round(
+            hists.get("bigdl_compile_seconds", {}).get("sum", 0.0), 3),
+        "cache_evictions": int(values.get(
+            "bigdl_compile_cache_evictions_total", 0)),
+        "peak_memory_bytes": int(peak) if peak else None,
+        "errors": [],
+    }
+    return {"schema": SCHEMA, "kind": "bigdl_tpu_serving_scoreboard",
+            "backend": "scrape", "workload": {"source": url},
+            "rows": [row]}
+
+
+# ---------------------------------------------------------------------------
+# Rendering + diff
+# ---------------------------------------------------------------------------
+
+def _fmt_ms(v: Optional[float]) -> str:
+    return "—" if v is None else f"{v * 1e3:.1f}"
+
+
+def _fmt_mem(v: Optional[float]) -> str:
+    return "—" if not v else f"{v / (1 << 20):.1f}"
+
+
+def render_markdown(artifact: dict) -> str:
+    """The PERF.md serving-scoreboard table."""
+    w = artifact.get("workload", {})
+    z = w.get("zipf", {})
+    lines = [
+        "| slots | tok/s | TTFT p50 (ms) | TTFT p95 (ms) | "
+        "per-token (ms) | compiles | compile s | evictions | "
+        "peak mem (MiB) |",
+        "|------:|------:|--------------:|--------------:|"
+        "---------------:|---------:|----------:|----------:|"
+        "---------------:|",
+    ]
+    for r in artifact.get("rows", []):
+        tok_s = r.get("tok_s")
+        lines.append(
+            f"| {r.get('slots', '?')} "
+            f"| {tok_s if tok_s is not None else '—'} "
+            f"| {_fmt_ms(r.get('ttft_p50_s'))} "
+            f"| {_fmt_ms(r.get('ttft_p95_s'))} "
+            f"| {_fmt_ms(r.get('token_latency_s'))} "
+            f"| {r.get('compiles', '—')} "
+            f"| {r.get('compile_seconds', '—')} "
+            f"| {r.get('cache_evictions', '—')} "
+            f"| {_fmt_mem(r.get('peak_memory_bytes'))} |")
+    meta = (f"backend={artifact.get('backend', '?')}, "
+            f"requests={w.get('requests', '?')}/slot-count, "
+            f"Zipf({z.get('alpha', '?')}) prompt lengths "
+            f"[{z.get('lmin', '?')}, {z.get('lmax', '?')}], "
+            f"seed={w.get('seed', '?')}")
+    lines.append("")
+    lines.append(f"<small>{meta}</small>")
+    return "\n".join(lines)
+
+
+def _rise(old: Optional[float], new: Optional[float]) -> Optional[float]:
+    if old is None or new is None or old <= 0:
+        return None
+    return (new - old) / old
+
+
+def diff(old: dict, new: dict,
+         thresholds: Optional[Dict[str, float]] = None) -> List[str]:
+    """Row-by-row (matched on slots) regression check. Returns human-
+    readable regression messages — empty means the gate passes. Metrics
+    absent on either side (CPU peak memory, scrape tok/s) are skipped:
+    the gate never fails on missing data, only on measured regressions."""
+    th = dict(DEFAULT_THRESHOLDS)
+    th.update(thresholds or {})
+    by_slots = {r.get("slots"): r for r in old.get("rows", [])}
+    out: List[str] = []
+    for nr in new.get("rows", []):
+        s = nr.get("slots")
+        orow = by_slots.get(s)
+        if orow is None:
+            continue                    # new slot count: nothing to gate
+        tag = f"slots={s}"
+        o_tok, n_tok = orow.get("tok_s"), nr.get("tok_s")
+        if o_tok and n_tok is not None and \
+                n_tok < o_tok * (1 - th["tok_s_drop"]):
+            out.append(f"{tag}: tok/s {o_tok} -> {n_tok} "
+                       f"(drop > {th['tok_s_drop']:.0%})")
+        for key, thr in (("ttft_p50_s", "ttft_p50_rise"),
+                         ("ttft_p95_s", "ttft_p95_rise"),
+                         ("token_latency_s", "token_latency_rise")):
+            r = _rise(orow.get(key), nr.get(key))
+            if r is not None and r > th[thr]:
+                out.append(f"{tag}: {key} {orow[key]} -> {nr[key]} "
+                           f"(rise > {th[thr]:.0%})")
+        o_c, n_c = orow.get("compiles"), nr.get("compiles")
+        if o_c is not None and n_c is not None and \
+                n_c > o_c + th["compiles_rise"]:
+            out.append(f"{tag}: compiles {o_c} -> {n_c} "
+                       f"(allowed +{int(th['compiles_rise'])})")
+        r = _rise(orow.get("peak_memory_bytes"), nr.get("peak_memory_bytes"))
+        if r is not None and r > th["peak_memory_rise"]:
+            out.append(f"{tag}: peak_memory_bytes "
+                       f"{orow['peak_memory_bytes']} -> "
+                       f"{nr['peak_memory_bytes']} "
+                       f"(rise > {th['peak_memory_rise']:.0%})")
+    for s in by_slots:
+        if s not in {r.get("slots") for r in new.get("rows", [])}:
+            out.append(f"slots={s}: row present in old artifact but "
+                       "missing from new")
+    return out
+
+
+def load_artifact(path: str) -> dict:
+    with open(path) as f:
+        obj = json.load(f)
+    if obj.get("kind") != "bigdl_tpu_serving_scoreboard":
+        raise ValueError(f"{path} is not a scoreboard artifact")
+    return obj

@@ -29,6 +29,7 @@ from typing import List, Optional
 
 __all__ = ["span", "enable", "disable", "is_enabled", "clear", "events",
            "to_chrome_trace", "dump", "set_capacity", "capacity",
+           "async_begin", "async_instant", "async_end", "complete_event",
            "DEFAULT_CAPACITY"]
 
 DEFAULT_CAPACITY = 65536
@@ -140,6 +141,56 @@ def span(name: str, cat: str = "bigdl", **args):
     if not _enabled:
         return _NOOP
     return _Span(name, cat, dict(args))
+
+
+def _async_event(ph: str, name: str, id: int, cat: str, args: dict) -> None:
+    ev = {"name": name, "cat": cat, "ph": ph, "id": int(id),
+          "ts": (time.perf_counter() - _T0) * 1e6,
+          "pid": os.getpid(), "tid": threading.get_ident()}
+    if args:
+        ev["args"] = args
+    with _lock:
+        _buffer.append(ev)
+
+
+def async_begin(name: str, id: int, cat: str = "bigdl", **args) -> None:
+    """Open a Chrome async phase (``ph: "b"``) under ``id``. Async events
+    sharing (cat, id, name) render as one lifecycle lane in Perfetto —
+    the per-request linkage the serving engines use: every phase of one
+    request carries the same id, so a single trace dump reconstructs its
+    submit -> queue -> admit -> decode -> complete journey."""
+    if _enabled:
+        _async_event("b", name, id, cat, args)
+
+
+def async_instant(name: str, id: int, cat: str = "bigdl", **args) -> None:
+    """Mark a point inside an open async phase (``ph: "n"``)."""
+    if _enabled:
+        _async_event("n", name, id, cat, args)
+
+
+def async_end(name: str, id: int, cat: str = "bigdl", **args) -> None:
+    """Close the async phase opened by ``async_begin`` with the same
+    (cat, id, name)."""
+    if _enabled:
+        _async_event("e", name, id, cat, args)
+
+
+def complete_event(name: str, t0: float, t1: float, cat: str = "bigdl",
+                   **args) -> None:
+    """Record an X event for an ALREADY-elapsed [t0, t1] window
+    (``time.perf_counter()`` values) — e.g. a request's queue wait, whose
+    start happened on another thread before anyone knew how long it would
+    be. ``span()`` covers the with-block case; this covers retrodiction."""
+    if not _enabled:
+        return
+    ev = {"name": name, "cat": cat, "ph": "X", "ts": (t0 - _T0) * 1e6,
+          "dur": max(0.0, (t1 - t0) * 1e6),
+          "pid": os.getpid(), "tid": threading.get_ident()}
+    if args:
+        ev["args"] = args
+    with _lock:
+        _buffer.append(ev)
 
 
 def to_chrome_trace() -> dict:

@@ -7,6 +7,7 @@
 #   ./scripts/bigdl-tpu.sh lint [paths... --select/--ignore/--format ...]
 #   ./scripts/bigdl-tpu.sh metrics [url|--selftest]   # scrape /metrics
 #   ./scripts/bigdl-tpu.sh trace [file|--selftest]    # Chrome trace tools
+#   ./scripts/bigdl-tpu.sh scoreboard [...|diff a b]  # serving scoreboard
 #   ./scripts/bigdl-tpu.sh chaos {corrupt|selftest} ...  # fault injection
 #   ./scripts/bigdl-tpu.sh resilience {validate|latest} <ckpt_dir>
 set -euo pipefail
@@ -27,11 +28,14 @@ if [[ "${1:-}" == "lint" ]]; then
 fi
 
 # --- telemetry subcommands (docs/OBSERVABILITY.md): scrape a serving
-#     process's /metrics, or validate/produce Chrome trace dumps. Both are
-#     jax-free (they run in milliseconds on a bare host).
+#     process's /metrics, validate/produce Chrome trace dumps, or run the
+#     serving scoreboard (workload driver + regression diff).
 #       ./scripts/bigdl-tpu.sh metrics localhost:8000
 #       ./scripts/bigdl-tpu.sh trace /tmp/bigdl_trace.json
-if [[ "${1:-}" == "metrics" || "${1:-}" == "trace" ]]; then
+#       ./scripts/bigdl-tpu.sh scoreboard --out sb.json --markdown
+#       ./scripts/bigdl-tpu.sh scoreboard diff old.json new.json
+if [[ "${1:-}" == "metrics" || "${1:-}" == "trace" \
+      || "${1:-}" == "scoreboard" ]]; then
   sub="$1"; shift
   root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
   export PYTHONPATH="$root${PYTHONPATH:+:$PYTHONPATH}"

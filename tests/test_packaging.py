@@ -103,3 +103,37 @@ def test_launcher_metrics_and_trace_subcommands(tmp_path):
     r = subprocess.run([launcher, "trace", str(bad)],
                        capture_output=True, timeout=60)
     assert r.returncode == 1
+
+
+def test_launcher_scoreboard_diff_subcommand(tmp_path):
+    """`bigdl-tpu.sh scoreboard diff` is the jax-free CI gate: exit 0 on
+    identical artifacts, exit 1 on an injected regression (the full run
+    mode is exercised in-process by tests/test_profiling.py)."""
+    import json
+
+    launcher = os.path.join(REPO, "scripts", "bigdl-tpu.sh")
+    artifact = {
+        "schema": 1, "kind": "bigdl_tpu_serving_scoreboard",
+        "backend": "cpu", "workload": {"requests": 4, "seed": 0,
+                                       "zipf": {"lmin": 3, "lmax": 6,
+                                                "alpha": 1.1}},
+        "rows": [{"slots": 8, "requests": 4, "failed": 0, "wall_s": 1.0,
+                  "tok_s": 100.0, "ttft_p50_s": 0.01, "ttft_p95_s": 0.05,
+                  "token_latency_s": 0.002, "compiles": 5,
+                  "compile_seconds": 1.0, "cache_evictions": 0,
+                  "peak_memory_bytes": None, "errors": []}],
+    }
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(artifact))
+    r = subprocess.run([launcher, "scoreboard", "diff", str(old),
+                        str(old)], capture_output=True, timeout=60)
+    assert r.returncode == 0, r.stderr.decode(errors="replace")
+    assert b"no regressions" in r.stdout
+
+    artifact["rows"][0]["tok_s"] = 10.0          # injected regression
+    new = tmp_path / "new.json"
+    new.write_text(json.dumps(artifact))
+    r = subprocess.run([launcher, "scoreboard", "diff", str(old),
+                        str(new)], capture_output=True, timeout=60)
+    assert r.returncode == 1
+    assert b"tok/s" in r.stderr

@@ -1,14 +1,18 @@
 """Telemetry subsystem (``bigdl_tpu/telemetry``): registry semantics,
 exposition formats, tracer ring buffer, the legacy ``Metrics`` bridge,
-live-server scrape (``GET /metrics``), submit-vs-scrape concurrency, and
-the disabled-path overhead budget.
+live-server scrape (``GET /metrics``), submit-vs-scrape concurrency, the
+disabled-path overhead budget, and the catalogue-drift gate (every
+``bigdl_*`` metric emitted under ``bigdl_tpu/`` is declared in
+``telemetry/catalogue.py`` and vice versa).
 
 Budget: the whole module must stay well under 15s — every serving test
 shares ONE module-scoped ContinuousLMServer (one prefill/insert/step
 compile) and all prompts share one length (no extra prefill programs).
 """
 
+import ast
 import json
+import os
 import re
 import threading
 import time
@@ -364,6 +368,97 @@ class TestConcurrentSubmitAndScrape:
         assert hist1["count"] - hist0["count"] == total
         ttft1 = tm.serving_ttft_seconds.labels().snapshot()
         assert ttft1["count"] - ttft0["count"] == total
+
+
+# ----------------------------------------------- catalogue-drift gate
+class TestCatalogueDriftGate:
+    """Instrumentation and docs can no longer diverge silently: every
+    metric family an instrument site touches (an attribute on a value
+    built by ``telemetry.instruments(...)``) must be declared in
+    ``catalogue.METRIC_SPECS``, and every declared family must be
+    touched by at least one site. Reuses the graftlint ProgramIndex
+    module walk (``analysis/program._index_module``) so import-alias
+    resolution — including function-level lazy imports — matches the
+    analyzer's, not an ad-hoc regex."""
+
+    @staticmethod
+    def _scan_tree():
+        from bigdl_tpu.analysis.core import _FUNC_TYPES, \
+            iter_own_statements
+        from bigdl_tpu.analysis.program import (_index_module,
+                                                module_name_for)
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = [os.path.join(root, "bench.py")]
+        for dirpath, _dirs, files in os.walk(
+                os.path.join(root, "bigdl_tpu")):
+            paths.extend(os.path.join(dirpath, f) for f in files
+                         if f.endswith(".py"))
+        emitted = set()
+        for path in sorted(paths):
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read())
+                except SyntaxError:
+                    continue
+            rec = _index_module(module_name_for(path), path, tree)
+            # which local names mean telemetry.instruments here
+            aliases = {n for n, (mod, sym) in rec.sym_imports.items()
+                       if sym == "instruments"
+                       and mod.startswith("bigdl_tpu.telemetry")}
+
+            def is_instruments_call(node):
+                if not isinstance(node, ast.Call):
+                    return False
+                f = node.func
+                return ((isinstance(f, ast.Name) and f.id in aliases)
+                        or (isinstance(f, ast.Attribute)
+                            and f.attr == "instruments"))
+
+            scopes = [tree] + list(rec.functions.values())
+            local_holders = {}      # scope id -> names bound per scope
+            attr_holders = set()    # self.<attr> bound anywhere in module
+            for scope in scopes:
+                names = set()
+                for node in iter_own_statements(scope):
+                    if isinstance(node, ast.Assign) and \
+                            is_instruments_call(node.value):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                names.add(t.id)
+                            elif (isinstance(t, ast.Attribute)
+                                  and isinstance(t.value, ast.Name)
+                                  and t.value.id == "self"):
+                                attr_holders.add(t.attr)
+                local_holders[id(scope)] = names
+            for scope in scopes:
+                names = local_holders[id(scope)]
+                for node in iter_own_statements(scope):
+                    if not isinstance(node, ast.Attribute):
+                        continue
+                    v = node.value
+                    hit = (is_instruments_call(v)
+                           or (isinstance(v, ast.Name) and v.id in names)
+                           or (isinstance(v, ast.Attribute)
+                               and isinstance(v.value, ast.Name)
+                               and v.value.id == "self"
+                               and v.attr in attr_holders))
+                    if hit and not node.attr.startswith("_"):
+                        emitted.add("bigdl_" + node.attr)
+        return emitted
+
+    def test_emitted_equals_declared(self):
+        from bigdl_tpu.telemetry.catalogue import METRIC_SPECS
+        declared = {s.name for s in METRIC_SPECS}
+        emitted = self._scan_tree()
+        undeclared = emitted - declared
+        assert not undeclared, (
+            f"metric families used by instrument sites but missing from "
+            f"telemetry/catalogue.py METRIC_SPECS: {sorted(undeclared)}")
+        unused = declared - emitted
+        assert not unused, (
+            f"metric families declared in telemetry/catalogue.py but "
+            f"emitted nowhere under bigdl_tpu/ or bench.py (dead docs): "
+            f"{sorted(unused)}")
 
 
 # ------------------------------------------------------- overhead budget
