@@ -1,14 +1,16 @@
 """JG013–JG014 — compile-cache hazards: traffic-dependent compile keys
 and unbounded jit-wrapper caches on loop-reachable paths.
 
-The serving compile storm is the motivating fixture: the continuous
-server's prefill compiles one XLA program per DISTINCT prompt length
+The serving compile storm was the motivating fixture: the continuous
+server's prefill compiled one XLA program per DISTINCT prompt length
 (``_prefill_fns[plen] = jax.jit(run)``), so arbitrary-length traffic
-from many users means arbitrary compiles and an ever-growing cache —
+from many users meant arbitrary compiles and an ever-growing cache —
 invisible in tests that reuse three prompt lengths, catastrophic at pod
-scale. Both rules reason about *jit-wrapper values*: a direct
-``jax.jit(...)`` call, a local name bound to one, or a call to a
-function whose whole-program summary says it returns a fresh wrapper
+scale. PR 15 fixed the real site (chunked prefill, O(1) programs; the
+pre-fix code survives as the frozen ``jg013_fire`` fixture). Both rules
+reason about *jit-wrapper values*: a direct ``jax.jit(...)`` call, a
+local name bound to one, or a call to a function whose whole-program
+summary says it returns a fresh wrapper
 (``models/generation._build_decode_fn`` style builders).
 """
 

@@ -511,6 +511,15 @@ def serve_cmd(argv) -> None:
                     "(prompt + generation budget)")
     ap.add_argument("--decodeBlock", type=int, default=8,
                     help="--continuous: tokens decoded per dispatch")
+    ap.add_argument("--prefillMode", default=None,
+                    choices=("chunked", "bucketed"),
+                    help="--continuous: O(1)-compile prefill strategy "
+                    "(default chunked, or BIGDL_PREFILL_MODE; bucketed "
+                    "= pow2 length buckets for attention paths that "
+                    "can't take the masked chunk)")
+    ap.add_argument("--prefillChunk", type=int, default=None,
+                    help="--continuous: chunked-prefill width (default "
+                    "128, or BIGDL_PREFILL_CHUNK)")
     ap.add_argument("--tokenizer", default=None,
                     help="BPE tokenizer path: requests may then POST "
                     '{"text": ...} and responses include decoded text')
@@ -564,7 +573,9 @@ def serve_cmd(argv) -> None:
             max_new_tokens=args.maxNewTokens,
             temperature=args.temperature, top_k=args.topK,
             top_p=args.topP, greedy=args.greedy,
-            eos_id=args.eosId, seed=args.seed)
+            eos_id=args.eosId, seed=args.seed,
+            prefill_mode=args.prefillMode,
+            prefill_chunk=args.prefillChunk)
     else:
         server = LMServer(model, max_batch=args.maxBatch,
                           batch_timeout_ms=args.batchTimeoutMs,

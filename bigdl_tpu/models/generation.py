@@ -502,6 +502,131 @@ def _set_decode_pos(buffers, value):
     return jtu.tree_map_with_path(visit, buffers)
 
 
+#: Buffer-tree leaf names that are PER-REQUEST prefill state (owned,
+#: donated, copied per admission) as opposed to shared model buffers
+#: (e.g. a quantized model's int8 weights — read-only across requests).
+_PREFILL_STATE_KEYS = ("k_cache", "v_cache", "decode_pos")
+
+
+def partition_prefill_state(bufs):
+    """Split a decode-mode buffer tree into ``(state, statics, merge)``.
+
+    ``state`` is the flat list of per-request leaves (KV caches + write
+    positions — everything a prefill mutates), ``statics`` the flat list
+    of every other buffer leaf, and ``merge(state, statics)`` rebuilds
+    the full tree (host-side, no copies). The chunked prefill programs
+    donate ONLY the state partition, so the per-admission copy scales
+    with the b=1 cache, never with model size (a quantized model's
+    weight buffers stay shared across admissions)."""
+    import jax.tree_util as jtu
+    leaves, treedef = jtu.tree_flatten_with_path(bufs)
+    is_state = [bool(p) and hasattr(p[-1], "key")
+                and str(p[-1].key) in _PREFILL_STATE_KEYS
+                for p, _ in leaves]
+    state = [x for (_, x), s in zip(leaves, is_state) if s]
+    statics = [x for (_, x), s in zip(leaves, is_state) if not s]
+
+    def merge(state, statics):
+        it_s, it_o = iter(state), iter(statics)
+        return jtu.tree_unflatten(
+            treedef, [next(it_s) if s else next(it_o) for s in is_state])
+
+    return state, statics, merge
+
+
+def build_chunked_prefill_fns(model: Module, template_bufs, *,
+                              site: str = "serving.prefill",
+                              registry=None):
+    """O(1)-compile chunked prompt prefill: exactly TWO programs
+    regardless of prompt length (the fix for the serving compile storm —
+    one program per distinct length, graftlint JG013/ROADMAP #1).
+
+    ``template_bufs`` is the b=1 decode-mode buffer tree the server
+    prefills from; its partition (``partition_prefill_state``) is baked
+    into the programs. Returns ``(chunk_fn, last_fn, state0, statics,
+    merge)``:
+
+    - ``chunk_fn(params, state, statics, chunk, new_pos) -> state``:
+      one fixed-width ``(1, C)`` chunk through the warm-cache chunked
+      attention branch (``nn.attention._attend_decode``'s multi-token
+      path — the same machinery speculative verification uses): k/v
+      write at the true cache positions ``decode_pos..decode_pos+C-1``
+      and the position mask ``k_pos <= q_pos`` keeps right-padding in a
+      ragged final chunk from ever being attended. ``new_pos`` (traced)
+      then forces ``decode_pos`` to the TRUE token count, so the pad
+      writes are re-covered by the next call. The head stays
+      last-position-sliced; intermediate chunks never materialise
+      logits.
+    - ``last_fn(params, state, statics, tok) -> (last log-probs,
+      state)``: the prompt's final token as a single warm step — its
+      ``(1, V)`` log-probs are the admission sample, read at the
+      token's true position with no dynamic indexing into a padded
+      chunk.
+
+    Trace-time contract (the serving engine's ``_single_mode`` handles
+    this): every attention module must have ``_decode_prefilled = True``
+    when either program is traced, so a cold cache takes the masked
+    warm-cache branch — correct at ``decode_pos = 0`` because unwritten
+    cache slots sit beyond the ``k_pos <= q_pos`` mask.
+
+    Both programs DONATE the ``state`` partition (caches + positions):
+    the chunk loop threads one cache through ⌈(L-1)/C⌉ sequential
+    calls, and without donation each call would allocate-and-copy the
+    full b=1 cache instead of updating it in place. The caller must
+    pass an OWNED state (copy ``state0`` once per prefill, never hand
+    over the template's own leaves); ``statics`` rides along
+    non-donated, shared across every admission.
+    """
+    from bigdl_tpu.telemetry.profiling import tracked_jit
+
+    state0, statics, merge = partition_prefill_state(template_bufs)
+
+    def extract(bufs):
+        # the state partition of an UPDATED full tree (functional_apply
+        # preserves structure, so the template's partition applies)
+        return partition_prefill_state(bufs)[0]
+
+    def run_chunk(params, state, statics, chunk, new_pos):
+        _, bufs = functional_apply(model, params, merge(state, statics),
+                                   chunk, training=False)
+        # the forward advanced decode_pos by the full chunk width, pad
+        # included; rewind to the true count INSIDE the program (one
+        # fused write, no extra host dispatch)
+        return extract(_set_decode_pos(bufs, new_pos))
+
+    def run_last(params, state, statics, tok):
+        lp, bufs = functional_apply(model, params, merge(state, statics),
+                                    tok, training=False)
+        return lp[:, -1], extract(bufs)
+
+    return (tracked_jit(run_chunk, site=site, registry=registry,
+                        donate_argnums=(1,)),
+            tracked_jit(run_last, site=site, registry=registry,
+                        donate_argnums=(1,)),
+            state0, statics, merge)
+
+
+def build_bucketed_prefill_fn(model: Module, *,
+                              site: str = "serving.prefill",
+                              registry=None):
+    """Power-of-two length-bucketed prompt prefill — the fallback for
+    models whose attention path can't take the masked warm-cache chunk
+    (``prefill_mode="bucketed"``): ONE ``tracked_jit`` wrapper whose
+    input is the prompt right-padded to its ``pow2_bucket`` length, so
+    XLA specializes one program per BUCKET (O(log max_len) total), not
+    per length. Runs the standard cold-cache causal prefill; the LM
+    heads must be in ``_decode_all`` mode at trace time because the true
+    last token sits at ``last_idx`` (traced), not at the padded end."""
+    from bigdl_tpu.telemetry.profiling import tracked_jit
+
+    def run(params, bufs, prompt, last_idx):
+        lp, bufs = functional_apply(model, params, bufs, prompt,
+                                    training=False)
+        return jnp.take(lp, last_idx, axis=1), bufs
+
+    return tracked_jit(run, site=site, registry=registry)
+
+
 def generate_speculative(target: Module, draft: Module, prompt,
                          max_new_tokens: int, *, spec_len: int = 4,
                          eos_id: Optional[int] = None,

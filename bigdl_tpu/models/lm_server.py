@@ -38,6 +38,7 @@ from typing import List, Optional
 import numpy as np
 
 from bigdl_tpu.telemetry import get_registry, instruments, span, tracing
+from bigdl_tpu.utils.util import pow2_bucket
 
 # Chrome-trace lifecycle ids for lmserver.request async events (matched
 # on (cat, id, name), so they may overlap the continuous server's ids)
@@ -251,12 +252,12 @@ class LMServer:
                                       phase="dispatch", batch=len(batch),
                                       wait_s=round(t_disp - req.t_submit, 6))
         s = len(batch[0].ids)
-        # batch-bucket: pad with copies of row 0 to the next power of two —
-        # dummy rows cost compute but keep the compile cache at
-        # O(log max_batch) entries per prompt length
-        b = 1
-        while b < len(batch):
-            b *= 2
+        # batch-bucket: pad with copies of row 0 to the next power of two
+        # (saturating at max_batch — the shared pow2_bucket helper, also
+        # the serving prefill's length-bucketing fallback) — dummy rows
+        # cost compute but keep the compile cache at O(log max_batch)
+        # entries per prompt length
+        b = pow2_bucket(len(batch), 1, self.max_batch)
         rows = [req.ids for req in batch]
         rows += [rows[0]] * (b - len(rows))
         prompt = np.asarray(rows, np.float32)

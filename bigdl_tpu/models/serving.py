@@ -10,10 +10,20 @@ scheduler, built TPU-first on static shapes):
   caches with a PER-ROW ``decode_pos`` (``nn.attention
   ._attend_decode_continuous``) — every slot lives at its own position in
   its own sequence, and ONE jitted step program advances them all;
-- a new request prefills OUT-OF-BAND as a b=1 forward (one compile per
-  prompt length), then a jitted insert scatters its (1, L) cache into a
-  free slot row and sets that row's ``decode_pos`` — admission never
-  recompiles or disturbs running slots;
+- a new request prefills OUT-OF-BAND as a b=1 forward in FIXED-SIZE
+  CHUNKS (``prefill_mode="chunked"``, the default): ⌈(L-1)/C⌉ chunks of
+  ``prefill_chunk`` tokens through the warm-cache chunked attention
+  branch plus one single-token step for the last prompt token — exactly
+  TWO compiled programs regardless of prompt length, where the old
+  per-length prefill compiled one program per distinct length (the
+  compile storm ROADMAP #1 tracked; graftlint JG013's frozen fire
+  fixture is that pre-fix code). ``prefill_mode="bucketed"`` is the
+  fallback for attention paths that can't take the masked chunk: the
+  prompt pads to its power-of-two ``pow2_bucket`` length and one
+  wrapper specializes per bucket (O(log max_len) programs). Either way
+  a jitted insert then scatters the (1, L) cache into a free slot row
+  and sets that row's ``decode_pos`` — admission never recompiles or
+  disturbs running slots;
 - steps dispatch in blocks of ``decode_block`` tokens (a ``lax.scan`` —
   amortizes the per-dispatch host cost); finished rows (eos/budget) free
   their slot at the next block boundary and the queue admits strictly
@@ -35,6 +45,7 @@ track a shared scalar position), no beam search. Sampling is the server's
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -46,19 +57,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.nn.module import functional_apply
-from bigdl_tpu.models.generation import _decode_modules, sample_token
+from bigdl_tpu.models.generation import (_decode_modules,
+                                         build_bucketed_prefill_fn,
+                                         build_chunked_prefill_fns,
+                                         sample_token)
 from bigdl_tpu.telemetry import get_registry, instruments, span, tracing
 from bigdl_tpu.telemetry.profiling import (sample_device_memory,
                                            tracked_jit)
+from bigdl_tpu.utils.util import pow2_bucket
 
-# Retained prefill programs (one per distinct prompt length). 64 lengths
-# cover any sane bucketing; past that the OLDEST length's program is
-# evicted (single-entry, counted in
-# bigdl_compile_cache_evictions_total{site="serving.prefill"}) and a
-# re-seen length pays one recompile — bounded memory beats unbounded
-# program retention under arbitrary-length traffic (graftlint JG014;
-# ROADMAP #1 tracks the real fix, chunked prefill = O(1) compiles).
-_PREFILL_CACHE_CAP = 64
+# Smallest prefill length bucket (prefill_mode="bucketed"): prompts
+# shorter than this share one program instead of minting one per small
+# power of two. The top bucket saturates at max_len.
+_PREFILL_BUCKET_LO = 16
 
 # One id per submitted request, process-wide: the Chrome-trace async
 # lifecycle key (serving.request) and the rid arg on every phase span.
@@ -94,9 +105,33 @@ class ContinuousLMServer:
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 0.0, greedy: bool = False,
                  eos_id: Optional[int] = None, seed: int = 0,
-                 registry=None):
+                 registry=None, prefill_mode: Optional[str] = None,
+                 prefill_chunk: Optional[int] = None):
         if slots < 1:
             raise ValueError("slots must be >= 1")
+        # prompt prefill strategy (both O(1)-compile; ROADMAP #1):
+        # "chunked" (default) = fixed-size chunks through the warm-cache
+        # chunked attention branch, two programs total; "bucketed" =
+        # pad the prompt to its power-of-two bucket, one program per
+        # bucket — the fallback for attention paths that can't take the
+        # masked multi-token chunk. Env levers mirror the args so a
+        # deployment can flip modes without code changes.
+        mode = (prefill_mode if prefill_mode is not None
+                else os.environ.get("BIGDL_PREFILL_MODE", "chunked"))
+        if mode not in ("chunked", "bucketed"):
+            raise ValueError(f"prefill_mode must be 'chunked' or "
+                             f"'bucketed', got {mode!r}")
+        chunk = int(prefill_chunk if prefill_chunk is not None
+                    else os.environ.get("BIGDL_PREFILL_CHUNK", "128"))
+        if chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        # a chunk wider than the cache buys nothing and multiplies the
+        # template-cache memory and per-prompt prefill work (prompts
+        # never exceed max_len - max_new); clamp rather than reject so
+        # the 128 default composes with small test/serving caches
+        chunk = min(chunk, max_len)
+        self.prefill_mode = mode
+        self.prefill_chunk = chunk
         # telemetry (docs/OBSERVABILITY.md): TTFT / per-token latency /
         # queue depth / slot occupancy — the serving SLO surface, exposed
         # by make_http_server as GET /metrics
@@ -135,9 +170,16 @@ class ContinuousLMServer:
 
         model.evaluate_mode()
         # single-request decode template (the prefill signature) FIRST,
-        # then the persistent continuous state
+        # then the persistent continuous state. The chunked template
+        # cache is padded up to a whole number of chunks so the final
+        # (right-padded) chunk's k/v write never clips against the cache
+        # end — the insert slices the copy back down to max_len.
+        if mode == "chunked":
+            self._prefill_cache_len = -(-max_len // chunk) * chunk
+        else:
+            self._prefill_cache_len = max_len
         for m in mhas:
-            m.enable_decode(1, max_len)
+            m.enable_decode(1, self._prefill_cache_len)
         for m in heads:
             m.enable_decode()
         _, small0 = model.functional_state()
@@ -149,7 +191,20 @@ class ContinuousLMServer:
         for m in mhas:
             m.enable_decode(slots, max_len, continuous=True)
         self.params, self.buffers = model.functional_state()
-        self._prefill_fns = {}
+        # the O(1) prefill program set, built BEFORE the worker thread
+        # starts (wrappers are cheap; XLA programs compile lazily inside
+        # tracked_jit at first dispatch, counted per signature in
+        # bigdl_compiles_total{site="serving.prefill"})
+        if mode == "chunked":
+            (self._chunk_fn, self._last_fn, self._prefill_state0,
+             self._prefill_statics, self._prefill_merge) = \
+                build_chunked_prefill_fns(model, self._small_bufs0,
+                                          registry=self.registry)
+            self._bucket_fn = None
+        else:
+            self._chunk_fn = self._last_fn = None
+            self._bucket_fn = build_bucketed_prefill_fn(
+                model, registry=self.registry)
         self._step_fn = None
         self._insert_fn = None
 
@@ -248,56 +303,112 @@ class ContinuousLMServer:
         return self._n_served
 
     # ------------------------------------------------------------- programs
-    def _single_mode(self):
+    @property
+    def _prefill_fns(self):
+        """The O(1) prefill program set — chunked mode holds the chunk +
+        last-token pair, bucketed mode one wrapper that specializes per
+        power-of-two bucket. Collapsed from the pre-PR-15 per-prompt-
+        length LRU (one program per distinct length, the compile storm
+        graftlint JG013's fire fixture preserves)."""
+        fns = {"chunk": self._chunk_fn, "last": self._last_fn,
+               "bucket": self._bucket_fn}
+        return {k: v for k, v in fns.items() if v is not None}
+
+    def _single_mode(self, prefilled: bool, all_logits: bool = False):
         """Context: flip the attention modules to single-request decode
-        semantics for tracing/running the b=1 prefill program."""
+        semantics for tracing/running the b=1 prefill programs.
+
+        ``prefilled`` is the trace-time cache temperature: True traces
+        the warm-cache masked branch (chunked prefill — correct on a
+        cold cache too, the position mask excludes unwritten slots),
+        False the cold causal fast path (bucketed prefill, which always
+        starts from scratch). ``all_logits`` flips the LM heads to emit
+        every position (the bucketed program reads the true last token
+        at a traced index inside the padded bucket)."""
         server = self
 
         class _Ctx:
             def __enter__(self):
                 for m in server._mhas:
                     m._continuous = False
-                    m._decode_prefilled = False
+                    m._decode_prefilled = prefilled
+                if all_logits:
+                    for h in server._heads:
+                        h._decode_all = True
                 return self
 
             def __exit__(self, *a):
                 for m in server._mhas:
                     m._continuous = True
                     m._decode_prefilled = True
+                if all_logits:
+                    for h in server._heads:
+                        h._decode_all = False
 
         return _Ctx()
 
-    def _prefill(self, plen: int):
-        """Jitted b=1 prompt prefill: (last log-probs, small buffers)."""
-        fn = self._prefill_fns.get(plen)
-        if fn is None:
-            model = self.model
+    def _prefill_chunked(self, ids: List[int]):
+        """Chunked b=1 prompt prefill: ⌈(L-1)/C⌉ fixed-width chunks that
+        write k/v at the true cache positions (final chunk right-padded,
+        pads masked and re-covered via the in-program ``decode_pos``
+        rewind), then ONE single-token step for the last prompt token
+        whose (1, V) log-probs feed the admission sample. Two compiled
+        programs total, any L."""
+        c = self.prefill_chunk
+        # both prefill programs donate the per-request STATE partition
+        # (caches + positions — in-place updates across the chunk loop);
+        # hand them an OWNED copy so the template survives this
+        # admission. Shared buffers (a quantized model's int8 weights)
+        # ride along non-donated: the per-admission copy scales with the
+        # b=1 cache, never with model size.
+        state = [jnp.copy(x) for x in self._prefill_state0]
+        statics = self._prefill_statics
+        n = len(ids) - 1        # last token runs as the lp-producing step
+        for start in range(0, n, c):
+            valid = min(c, n - start)
+            chunk = np.ones((1, c), np.float32)   # pad id 1: any valid id
+            chunk[0, :valid] = ids[start:start + valid]
+            state = self._chunk_fn(self.params, state, statics,
+                                   jnp.asarray(chunk),
+                                   jnp.int32(start + valid))
+        last = np.asarray([[ids[-1]]], np.float32)
+        lp, state = self._last_fn(self.params, state, statics,
+                                  jnp.asarray(last))
+        # the insert consumes the FULL small tree (structure must match
+        # the big tree leaf-for-leaf); merge is host-side, copy-free
+        return lp, self._prefill_merge(state, statics)
 
-            def run(params, bufs, prompt):
-                lp, bufs = functional_apply(model, params, bufs, prompt,
-                                            training=False)
-                return lp[:, -1], bufs
+    def _prefill_bucketed(self, ids: List[int]):
+        """Length-bucketed b=1 prompt prefill (fallback mode): the
+        prompt right-pads to its power-of-two bucket and runs the
+        standard cold causal prefill — one program per BUCKET
+        (O(log max_len) total), with the true last token's log-probs
+        read at a traced index."""
+        plen = len(ids)
+        cap = self._prefill_cache_len
+        bsz = pow2_bucket(plen, min(_PREFILL_BUCKET_LO, cap), cap)
+        prompt = np.ones((1, bsz), np.float32)
+        prompt[0, :plen] = ids
+        return self._bucket_fn(self.params, self._small_bufs0,
+                               jnp.asarray(prompt), jnp.int32(plen - 1))
 
-            fn = tracked_jit(run, site="serving.prefill",
-                             registry=self.registry)
-            while len(self._prefill_fns) >= _PREFILL_CACHE_CAP:
-                # arbitrary-length traffic must not retain one compiled
-                # program per length forever (graftlint JG014) — and
-                # clear-at-cap caused an eviction STORM: every live
-                # prompt length recompiled immediately after the wipe.
-                # Oldest-first single-entry eviction drops exactly one
-                # length, counted so the scrape shows cache pressure.
-                self._prefill_fns.pop(next(iter(self._prefill_fns)))
-                self._tm.compile_cache_evictions_total.labels(
-                    site="serving.prefill").inc()
-            # one compile per DISTINCT prompt length — the known serving
-            # compile storm; bounded in count above, but the per-length
-            # compile latency itself is ROADMAP #1 (chunked prefill)
-            # graftlint: ignore[JG013] -- per-prompt-length compile family is the documented serving design until chunked prefill (ROADMAP #1); retention bounded by _PREFILL_CACHE_CAP
-            self._prefill_fns[plen] = fn
-            # first-seen prompt length == a fresh XLA program at next call
-            self._tm.serving_recompiles_total.inc()
-        return fn
+    def _run_prefill(self, ids: List[int]):
+        """Mode dispatch + compile accounting: any program the flight
+        recorder built during this prefill counts as serving recompile
+        churn (per NEW SIGNATURE — a bucketed wrapper minting its
+        second bucket counts exactly like a fresh program build)."""
+        fns = self._prefill_fns
+        before = sum(fn.compiles for fn in fns.values())
+        if self.prefill_mode == "bucketed":
+            with self._single_mode(prefilled=False, all_logits=True):
+                out = self._prefill_bucketed(ids)
+        else:
+            with self._single_mode(prefilled=True):
+                out = self._prefill_chunked(ids)
+        built = sum(fn.compiles for fn in fns.values()) - before
+        if built:
+            self._tm.serving_recompiles_total.inc(built)
+        return out
 
     def _insert(self):
         """Jitted scatter of a prefilled b=1 cache into slot row ``slot``
@@ -310,8 +421,13 @@ class ContinuousLMServer:
                 for (kp, bg), (_, sm) in zip(flat_b, flat_s):
                     name = str(kp[-1])
                     if "k_cache" in name or "v_cache" in name:
+                        # the chunked-prefill template cache is padded to
+                        # a whole number of chunks; only the first
+                        # max_len entries are live (anything past the
+                        # prompt is masked pad garbage) — slice before
+                        # the scatter (no-op when lengths already match)
                         out.append(jax.lax.dynamic_update_slice(
-                            bg, sm.astype(bg.dtype),
+                            bg, sm.astype(bg.dtype)[:, :bg.shape[1]],
                             (slot,) + (0,) * (bg.ndim - 1)))
                     elif "decode_pos" in name:
                         out.append(jax.lax.dynamic_update_slice(
@@ -361,12 +477,9 @@ class ContinuousLMServer:
         tracing.complete_event("serving.queue_wait", req.t_submit, t_admit,
                                rid=req.rid)
         try:
-            with span("serving.prefill", plen=plen, rid=req.rid):
-                with self._single_mode():
-                    prompt = jnp.asarray(
-                        np.asarray(req.ids, np.float32)[None])
-                    lp, small = self._prefill(plen)(
-                        self.params, self._small_bufs0, prompt)
+            with span("serving.prefill", plen=plen, rid=req.rid,
+                      mode=self.prefill_mode):
+                lp, small = self._run_prefill(req.ids)
                 # key advances per ADMISSION (not per completion — several
                 # admits can happen between completions, and identical
                 # prompts sampled under a reused key would correlate

@@ -217,7 +217,8 @@ def cmd_scoreboard(args) -> int:
             lmin=args.lmin, lmax=args.lmax, alpha=args.alpha,
             max_new=args.max_new, vocab=args.vocab, embed=args.embed,
             heads=args.heads, ffn=args.ffn, layers=args.layers,
-            timeout=args.timeout)
+            timeout=args.timeout, prefill_mode=args.prefill_mode,
+            prefill_chunk=args.prefill_chunk)
         artifact = sb.run(cfg)
     body = json.dumps(artifact, indent=2)
     if args.out:
@@ -292,6 +293,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ps.add_argument("--ffn", type=int, default=64)
     ps.add_argument("--layers", type=int, default=2)
     ps.add_argument("--timeout", type=float, default=600.0)
+    ps.add_argument("--prefill-mode", dest="prefill_mode",
+                    choices=("chunked", "bucketed"), default="chunked",
+                    help="serving prefill strategy (both O(1)-compile; "
+                         "chunked = fixed-size chunks, bucketed = pow2 "
+                         "length buckets)")
+    ps.add_argument("--prefill-chunk", type=int, dest="prefill_chunk",
+                    default=16, help="chunked-mode chunk width")
     ps.add_argument("--out", default="",
                     help="write the JSON artifact here (default: stdout)")
     ps.add_argument("--markdown", action="store_true",

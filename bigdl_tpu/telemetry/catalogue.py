@@ -47,7 +47,8 @@ METRIC_SPECS: List[MetricSpec] = [
     MetricSpec("bigdl_serving_request_errors_total", "counter",
                "Requests failed (admission or decode error)."),
     MetricSpec("bigdl_serving_recompiles_total", "counter",
-               "New XLA program builds: first-seen prompt length prefill, "
+               "New XLA program builds: the O(1) chunked-prefill pair "
+               "(or a first-seen pow2 length bucket in bucketed mode), "
                "the step program, the insert program."),
     MetricSpec("bigdl_serving_decode_blocks_total", "counter",
                "Jitted decode blocks dispatched over all slots."),

@@ -57,8 +57,9 @@ __all__ = ["tracked_jit", "TrackedJit", "CompileEvent", "peak_flops",
            "sample_device_memory", "DEFAULT_CACHE_SIZE"]
 
 #: Default retained-executable bound per tracked site. Generous for
-#: steady-state sites (a training loop has ONE signature); the serving
-#: prefill passes its own cap (= the documented _PREFILL_CACHE_CAP).
+#: steady-state sites (a training loop has ONE signature) and for the
+#: O(1)/O(log) program families the chunked/bucketed serving prefill
+#: dispatches through a single wrapper.
 DEFAULT_CACHE_SIZE = 64
 
 

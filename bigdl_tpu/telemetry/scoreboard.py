@@ -70,7 +70,8 @@ class ScoreboardConfig:
                  max_new: int = 16, decode_block: int = 4,
                  vocab: int = 256, embed: int = 32, heads: int = 2,
                  ffn: int = 64, layers: int = 2,
-                 timeout: float = 600.0):
+                 timeout: float = 600.0, prefill_mode: str = "chunked",
+                 prefill_chunk: int = 16):
         self.slots = [int(s) for s in slots]
         self.requests = int(requests)
         self.clients = max(1, int(clients))
@@ -83,6 +84,11 @@ class ScoreboardConfig:
         self.embed, self.heads = int(embed), int(heads)
         self.ffn, self.layers = int(ffn), int(layers)
         self.timeout = float(timeout)
+        # chunked (default) vs bucketed prefill — the PR-15 O(1)-compile
+        # modes; the chunk default is sized to the Zipf lmax so a toy
+        # workload still exercises a multi-chunk prompt now and then
+        self.prefill_mode = str(prefill_mode)
+        self.prefill_chunk = int(prefill_chunk)
         self.max_len = self.lmax + self.max_new + 8
 
     def workload_dict(self) -> dict:
@@ -91,6 +97,8 @@ class ScoreboardConfig:
                                             "lmax": self.lmax,
                                             "alpha": self.alpha},
                 "max_new": self.max_new,
+                "prefill": {"mode": self.prefill_mode,
+                            "chunk": self.prefill_chunk},
                 "model": {"vocab": self.vocab, "embed": self.embed,
                           "heads": self.heads, "ffn": self.ffn,
                           "layers": self.layers}}
@@ -161,7 +169,9 @@ def _drive_one(cfg: ScoreboardConfig, slots: int) -> dict:
     server = ContinuousLMServer(model, slots=slots, max_len=cfg.max_len,
                                 decode_block=cfg.decode_block, greedy=True,
                                 max_new_tokens=cfg.max_new,
-                                seed=cfg.seed, registry=registry)
+                                seed=cfg.seed, registry=registry,
+                                prefill_mode=cfg.prefill_mode,
+                                prefill_chunk=cfg.prefill_chunk)
     prompts = make_prompts(cfg)
     errors: List[str] = []
     lock = threading.Lock()
@@ -210,6 +220,7 @@ def _drive_one(cfg: ScoreboardConfig, slots: int) -> dict:
     tokens = tm.serving_tokens_total.value
     return {
         "slots": slots,
+        "prefill_mode": cfg.prefill_mode,
         "requests": len(prompts),
         "failed": len(errors),
         "wall_s": round(wall, 3),
@@ -311,6 +322,7 @@ def scrape(url: str, timeout: float = 5.0) -> dict:
     peak = values.get("bigdl_device_memory_peak_bytes")
     row = {
         "slots": int(values.get("bigdl_serving_slots_total", 0)),
+        "prefill_mode": None,       # not exposed by /metrics; unknown
         "requests": int(values.get(
             "bigdl_serving_requests_completed_total", 0)),
         "failed": int(values.get("bigdl_serving_request_errors_total", 0)),
@@ -351,10 +363,10 @@ def render_markdown(artifact: dict) -> str:
     w = artifact.get("workload", {})
     z = w.get("zipf", {})
     lines = [
-        "| slots | tok/s | TTFT p50 (ms) | TTFT p95 (ms) | "
+        "| slots | prefill | tok/s | TTFT p50 (ms) | TTFT p95 (ms) | "
         "per-token (ms) | compiles | compile s | evictions | "
         "peak mem (MiB) |",
-        "|------:|------:|--------------:|--------------:|"
+        "|------:|:--------|------:|--------------:|--------------:|"
         "---------------:|---------:|----------:|----------:|"
         "---------------:|",
     ]
@@ -362,6 +374,7 @@ def render_markdown(artifact: dict) -> str:
         tok_s = r.get("tok_s")
         lines.append(
             f"| {r.get('slots', '?')} "
+            f"| {r.get('prefill_mode') or '—'} "
             f"| {tok_s if tok_s is not None else '—'} "
             f"| {_fmt_ms(r.get('ttft_p50_s'))} "
             f"| {_fmt_ms(r.get('ttft_p95_s'))} "

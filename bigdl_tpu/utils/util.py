@@ -7,6 +7,30 @@ import ctypes
 import numpy as np
 
 
+def pow2_bucket(n: int, lo: int, hi: int) -> int:
+    """Smallest power of two >= ``n``, clamped into ``[lo, hi]``.
+
+    The shared shape-bucketing primitive: padding a traffic-dependent
+    dimension (batch size, prompt length) to a power of two bounds the
+    distinct compiled-program set at O(log range) instead of one program
+    per observed value (graftlint JG013). ``lo`` floors tiny values into
+    one shared bucket; ``hi`` caps the top bucket at the physical limit
+    (cache length, max batch) and need not itself be a power of two —
+    the top bucket simply saturates at ``hi``. Used by the bucketed
+    ``LMServer`` batch padding and ``ContinuousLMServer``'s
+    ``prefill_mode="bucketed"`` length fallback."""
+    if n < 1:
+        raise ValueError(f"pow2_bucket needs n >= 1, got {n}")
+    if not 1 <= lo <= hi:
+        raise ValueError(f"pow2_bucket needs 1 <= lo <= hi, got "
+                         f"lo={lo}, hi={hi}")
+    if n > hi:
+        raise ValueError(f"pow2_bucket: n={n} exceeds the bucket cap "
+                         f"hi={hi}")
+    b = 1 << (n - 1).bit_length()       # next power of two >= n
+    return min(max(b, lo), hi)
+
+
 def kth_largest(values, k: int) -> float:
     """k-th largest element, k is 1-based (reference ``Util.kthLargest`` —
     quickselect; used for the straggler-drop threshold). Native-backed."""

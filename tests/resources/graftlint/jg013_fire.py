@@ -1,8 +1,11 @@
-"""JG013 positive: the real compile storm from models/serving.py —
-the continuous server's prefill jit cache keyed by prompt LENGTH
-(``_prefill()``), one fresh XLA program per distinct length seen in
-traffic. This fixture is the pre-fix serving pattern verbatim in shape:
-a dict of jit wrappers stored under a request-derived key."""
+"""JG013 positive: the real compile storm that used to live in
+models/serving.py — the continuous server's prefill jit cache keyed by
+prompt LENGTH (``_prefill()``), one fresh XLA program per distinct
+length seen in traffic. PR 15 replaced that code with chunked prefill
+(O(1) programs; ``prefill_mode="bucketed"`` as the pow2 fallback), so
+this fixture is a FROZEN copy of the pre-fix pattern — kept verbatim in
+shape (a dict of jit wrappers stored under a request-derived key) so
+the rule retains its real-world positive."""
 import jax
 
 

@@ -28,6 +28,62 @@ def _direct(lm, rows, max_new, eos_id=None):
     return [r[len(rows[0]):].tolist() for r in out]
 
 
+class TestPow2Bucket:
+    """The shared shape-bucketing helper (PR 15): one definition drives
+    both the batch-dim padding here and the continuous server's prefill
+    length-bucketing fallback."""
+
+    def test_edge_powers(self):
+        from bigdl_tpu.utils.util import pow2_bucket
+        # exact powers map to themselves; off-by-one rounds up
+        assert pow2_bucket(1, 1, 64) == 1
+        assert pow2_bucket(2, 1, 64) == 2
+        assert pow2_bucket(3, 1, 64) == 4
+        assert pow2_bucket(4, 1, 64) == 4
+        assert pow2_bucket(5, 1, 64) == 8
+        assert pow2_bucket(63, 1, 64) == 64
+        assert pow2_bucket(64, 1, 64) == 64
+        # lo floors tiny values into one shared bucket
+        assert pow2_bucket(3, 16, 64) == 16
+        assert pow2_bucket(17, 16, 64) == 32
+        # hi saturates the top bucket and need not be a power of two
+        assert pow2_bucket(5, 1, 6) == 6
+        assert pow2_bucket(6, 1, 6) == 6
+        assert pow2_bucket(33, 16, 48) == 48
+
+    def test_rejects_out_of_range(self):
+        from bigdl_tpu.utils.util import pow2_bucket
+        with pytest.raises(ValueError, match="n >= 1"):
+            pow2_bucket(0, 1, 8)
+        with pytest.raises(ValueError, match="exceeds"):
+            pow2_bucket(9, 1, 8)
+        with pytest.raises(ValueError, match="lo <= hi"):
+            pow2_bucket(1, 8, 4)
+
+    def test_batch_padding_uses_bucket(self, lm):
+        """Concurrent same-length requests dispatch through the bucketed
+        batch pad (3 gathered rows -> a 4-row program, dummy row
+        dropped) and still match direct generate row-for-row."""
+        srv = LMServer(lm, greedy=True, max_batch=6, max_new_tokens=4,
+                       batch_timeout_ms=200.0)
+        try:
+            rows = [[3, 5, 7], [2, 4, 6], [9, 1, 8]]
+            results = [None] * 3
+            threads = [threading.Thread(
+                target=lambda i=i: results.__setitem__(
+                    i, srv.submit(rows[i], 4, timeout=120)))
+                for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            want = _direct(lm, rows, 4)
+            for i in range(3):
+                assert results[i] == want[i], i
+        finally:
+            srv.close()
+
+
 class TestLMServer:
     def test_single_request_matches_direct_generate(self, lm):
         srv = LMServer(lm, greedy=True, max_new_tokens=8)

@@ -137,3 +137,28 @@ def test_launcher_scoreboard_diff_subcommand(tmp_path):
                         str(new)], capture_output=True, timeout=60)
     assert r.returncode == 1
     assert b"tok/s" in r.stderr
+
+
+def test_scoreboard_diff_r01_to_r02_checked_in_artifacts():
+    """The PR-15 before/after gate on the CHECKED-IN artifacts: r01
+    (per-length prefill, 14 programs/row under the Zipf workload) ->
+    r02 (chunked prefill, O(1) programs) must clear every default
+    threshold — in particular `compiles_rise: 0` holds with room to
+    spare, since r02 builds a strict subset of r01's programs."""
+    import json
+
+    launcher = os.path.join(REPO, "scripts", "bigdl-tpu.sh")
+    r01 = os.path.join(REPO, "SCOREBOARD_r01.json")
+    r02 = os.path.join(REPO, "SCOREBOARD_r02.json")
+    r = subprocess.run([launcher, "scoreboard", "diff", r01, r02],
+                       capture_output=True, timeout=60)
+    assert r.returncode == 0, r.stderr.decode(errors="replace")
+    assert b"no regressions" in r.stdout
+    # the tentpole claim itself: every r02 row is bounded at <= 4
+    # programs total (prefill pair + insert + step) where r01 minted one
+    # prefill program per distinct prompt length
+    rows = json.load(open(r02))["rows"]
+    assert rows and all(r["compiles"] <= 4 for r in rows)
+    assert all(r["prefill_mode"] == "chunked" for r in rows)
+    old_rows = {r["slots"]: r for r in json.load(open(r01))["rows"]}
+    assert all(old_rows[r["slots"]]["compiles"] >= 14 for r in rows)

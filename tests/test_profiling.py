@@ -329,7 +329,8 @@ GOLDEN_ARTIFACT = {
                  "model": {"vocab": 256, "embed": 32, "heads": 2,
                            "ffn": 64, "layers": 2}},
     "rows": [
-        {"slots": 8, "requests": 48, "failed": 0, "wall_s": 12.0,
+        {"slots": 8, "prefill_mode": "chunked", "requests": 48,
+         "failed": 0, "wall_s": 12.0,
          "tok_s": 64.0, "ttft_p50_s": 0.05, "ttft_p95_s": 0.25,
          "token_latency_s": 0.004, "compiles": 9, "compile_seconds": 4.2,
          "cache_evictions": 0, "peak_memory_bytes": 41943040,
@@ -343,10 +344,10 @@ GOLDEN_ARTIFACT = {
 }
 
 GOLDEN_MARKDOWN = """\
-| slots | tok/s | TTFT p50 (ms) | TTFT p95 (ms) | per-token (ms) | compiles | compile s | evictions | peak mem (MiB) |
-|------:|------:|--------------:|--------------:|---------------:|---------:|----------:|----------:|---------------:|
-| 8 | 64.0 | 50.0 | 250.0 | 4.0 | 9 | 4.2 | 0 | 40.0 |
-| 16 | 96.0 | 100.0 | 500.0 | 5.0 | 9 | 4.4 | 0 | 50.0 |
+| slots | prefill | tok/s | TTFT p50 (ms) | TTFT p95 (ms) | per-token (ms) | compiles | compile s | evictions | peak mem (MiB) |
+|------:|:--------|------:|--------------:|--------------:|---------------:|---------:|----------:|----------:|---------------:|
+| 8 | chunked | 64.0 | 50.0 | 250.0 | 4.0 | 9 | 4.2 | 0 | 40.0 |
+| 16 | — | 96.0 | 100.0 | 500.0 | 5.0 | 9 | 4.4 | 0 | 50.0 |
 
 <small>backend=tpu, requests=48/slot-count, Zipf(1.1) prompt lengths [4, 24], seed=0</small>"""
 
@@ -434,8 +435,11 @@ class TestScoreboard:
         assert row["tok_s"] > 0
         assert row["ttft_p50_s"] is not None
         assert row["token_latency_s"] > 0
-        # the flight recorder saw the step + insert + >=1 prefill builds
-        assert row["compiles"] >= 3
+        # the flight recorder saw the step + insert + the O(1) chunked
+        # prefill pair — and NOTHING per-length (PR 15: the pre-fix
+        # engine minted one program per distinct Zipf prompt length)
+        assert 3 <= row["compiles"] <= 4
+        assert row["prefill_mode"] == "chunked"
         assert row["compile_seconds"] > 0
         md = scoreboard.render_markdown(artifact)
         assert "| 2 |" in md

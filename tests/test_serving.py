@@ -302,23 +302,116 @@ class TestContinuousSampling:
             srv.close()
 
 
-class TestCompileCacheBound:
-    """Regression for the graftlint JG014 fix: the per-prompt-length
-    prefill program cache is bounded (arbitrary-length traffic must not
-    retain one compiled program per length forever)."""
+class TestChunkedPrefill:
+    """PR 15 (ROADMAP #1): prefill is O(1) compiled programs regardless
+    of prompt length — chunked by default, pow2-length-bucketed as the
+    fallback — and greedy outputs stay bit-identical to the monolithic
+    prefill path (``models.generate``, which prefills the whole prompt
+    in one causal forward)."""
 
-    def test_prefill_cache_clears_at_cap(self, monkeypatch):
-        from bigdl_tpu.models import serving as serving_mod
-        monkeypatch.setattr(serving_mod, "_PREFILL_CACHE_CAP", 2)
+    # chunk width for the differential: small enough that the edge
+    # lengths {1, C-1, C, C+1, 2C+3} all fit a 32-slot cache
+    C = 4
+
+    def _edge_lengths(self, max_len, max_new):
+        c = self.C
+        lens = [1, c - 1, c, c + 1, 2 * c + 3]
+        # plus a prompt that fills the cache to max_len - max_new EXACTLY
+        # (the last chunk's k/v write must not clip against the cache end)
+        lens.append(max_len - max_new)
+        return lens
+
+    @pytest.mark.parametrize("mode", ["chunked", "bucketed"])
+    def test_bit_exact_vs_monolithic_prefill(self, mode):
+        max_len, max_new = 32, 4
         model, ref = _mk_model(), _mk_model()
-        srv = ContinuousLMServer(model, slots=2, max_len=32, greedy=True,
-                                 decode_block=2)
+        srv = ContinuousLMServer(model, slots=2, max_len=max_len,
+                                 greedy=True, decode_block=4,
+                                 prefill_mode=mode, prefill_chunk=self.C)
         try:
-            for ids in ([4], [4, 7], [4, 7, 2], [4, 7, 2, 9]):
-                got = srv.submit(ids, max_new_tokens=3, timeout=120)
-                # eviction must never change what gets served
-                assert got == _ref_continuation(ref, ids, 3)
-            assert len(srv._prefill_fns) <= 2
+            for n in self._edge_lengths(max_len, max_new):
+                ids = [(3 * i) % VOCAB + 1 for i in range(n)]
+                got = srv.submit(ids, max_new_tokens=max_new, timeout=120)
+                assert got == _ref_continuation(ref, ids, max_new), \
+                    (mode, n)
+        finally:
+            srv.close()
+
+    def test_compile_count_bounded_under_many_lengths(self):
+        """The compile-storm gate: 20+ DISTINCT prompt lengths through
+        one server mint <= 3 prefill programs (measured by the PR-14
+        flight recorder at site serving.prefill), the program set stays
+        O(1), and late admissions pay no per-length compile stall —
+        where the pre-fix engine compiled once per length (the frozen
+        jg013 fire fixture)."""
+        from bigdl_tpu.telemetry import MetricsRegistry, instruments
+        registry = MetricsRegistry()
+        model = _mk_model()
+        srv = ContinuousLMServer(model, slots=2, max_len=32, greedy=True,
+                                 decode_block=4, prefill_chunk=8,
+                                 registry=registry)
+        lat = []
+        try:
+            for n in range(1, 23):          # 22 distinct prompt lengths
+                ids = [(5 * i) % VOCAB + 1 for i in range(n)]
+                t0 = time.perf_counter()
+                out = srv.submit(ids, max_new_tokens=2, timeout=120)
+                lat.append(time.perf_counter() - t0)
+                assert len(out) == 2
+        finally:
+            srv.close()
+        tm = instruments(registry)
+        prefill_compiles = tm.compiles_total.labels(
+            site="serving.prefill").value
+        assert prefill_compiles <= 3, prefill_compiles
+        assert len(srv._prefill_fns) <= 3
+        # flat admission latency: every compile happened in the first
+        # requests, so the last 10 admissions must not be slower than
+        # the first 10 (generous noise margin for a shared host — the
+        # hard gate above is the compile count)
+        first, last = lat[:10], lat[-10:]
+        assert sum(last) / 10 <= sum(first) / 10 * 1.5 + 0.05, (first,
+                                                                last)
+
+    def test_recompiles_counter_tracks_prefill_builds(self):
+        """bigdl_serving_recompiles_total counts NEW prefill program
+        signatures (plus the one-time step/insert builds), not one per
+        admission — a second pass over re-seen lengths adds nothing."""
+        from bigdl_tpu.telemetry import MetricsRegistry, instruments
+        registry = MetricsRegistry()
+        srv = ContinuousLMServer(_mk_model(), slots=2, max_len=32,
+                                 greedy=True, decode_block=4,
+                                 prefill_chunk=4, registry=registry)
+        try:
+            for ids in ([3, 7], [3, 7, 2, 9, 5], [3, 7], [3, 7, 2, 9, 5]):
+                srv.submit(ids, max_new_tokens=2, timeout=120)
+            after_first = instruments(registry).serving_recompiles_total \
+                .value
+            srv.submit([4, 4, 4], max_new_tokens=2, timeout=120)
+            assert instruments(registry).serving_recompiles_total.value \
+                == after_first
+        finally:
+            srv.close()
+
+    def test_rejects_bad_prefill_config(self):
+        with pytest.raises(ValueError, match="prefill_mode"):
+            ContinuousLMServer(_mk_model(), slots=1, max_len=16,
+                               prefill_mode="monolithic")
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            ContinuousLMServer(_mk_model(), slots=1, max_len=16,
+                               prefill_chunk=0)
+
+    def test_chunk_wider_than_cache_is_clamped(self):
+        """The 128 default against a small cache must not multiply the
+        template-cache memory (or attempt an absurd allocation from a
+        stale BIGDL_PREFILL_CHUNK): the chunk clamps to max_len."""
+        srv = ContinuousLMServer(_mk_model(), slots=1, max_len=16,
+                                 greedy=True, prefill_chunk=1 << 20)
+        try:
+            assert srv.prefill_chunk == 16
+            assert srv._prefill_cache_len == 16
+            assert len(srv.submit([3, 7, 2], max_new_tokens=3,
+                                  timeout=120)) == 3
         finally:
             srv.close()
 
