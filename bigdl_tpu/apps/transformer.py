@@ -520,6 +520,23 @@ def serve_cmd(argv) -> None:
     ap.add_argument("--prefillChunk", type=int, default=None,
                     help="--continuous: chunked-prefill width (default "
                     "128, or BIGDL_PREFILL_CHUNK)")
+    ap.add_argument("--draft", default=None, metavar="PATH",
+                    help="--continuous: saved draft model path (file_io) "
+                    "enabling speculative decode — the draft proposes "
+                    "specLen tokens per round, the target verifies in one "
+                    "dispatch; greedy-only, outputs bit-identical to "
+                    "non-speculative decode")
+    ap.add_argument("--specLen", type=int, default=None,
+                    help="--continuous --draft: draft tokens proposed per "
+                    "round (default 4, or BIGDL_SPEC_LEN)")
+    ap.add_argument("--prefixCache", default=None,
+                    choices=("on", "off"),
+                    help="--continuous: cross-request KV prefix cache "
+                    "over chunk-aligned prompt prefixes (default on in "
+                    "chunked mode, or BIGDL_PREFIX_CACHE)")
+    ap.add_argument("--prefixCacheMB", type=float, default=None,
+                    help="--continuous: prefix-cache budget in MiB "
+                    "(default 64, or BIGDL_PREFIX_CACHE_MB)")
     ap.add_argument("--tokenizer", default=None,
                     help="BPE tokenizer path: requests may then POST "
                     '{"text": ...} and responses include decoded text')
@@ -567,6 +584,7 @@ def serve_cmd(argv) -> None:
         args.eosId = tok.eos_id
     if args.continuous:
         from bigdl_tpu.models.serving import ContinuousLMServer
+        draft = file_io.load(args.draft) if args.draft else None
         server = ContinuousLMServer(
             model, slots=args.slots, max_len=args.maxLen,
             decode_block=args.decodeBlock,
@@ -575,7 +593,14 @@ def serve_cmd(argv) -> None:
             top_p=args.topP, greedy=args.greedy,
             eos_id=args.eosId, seed=args.seed,
             prefill_mode=args.prefillMode,
-            prefill_chunk=args.prefillChunk)
+            prefill_chunk=args.prefillChunk,
+            draft=draft, spec_len=args.specLen,
+            prefix_cache=(None if args.prefixCache is None
+                          else args.prefixCache == "on"),
+            prefix_cache_mb=args.prefixCacheMB)
+    elif args.draft or args.specLen or args.prefixCache:
+        raise SystemExit("--draft/--specLen/--prefixCache require "
+                         "--continuous")
     else:
         server = LMServer(model, max_batch=args.maxBatch,
                           batch_timeout_ms=args.batchTimeoutMs,

@@ -502,6 +502,23 @@ def _set_decode_pos(buffers, value):
     return jtu.tree_map_with_path(visit, buffers)
 
 
+def _shift_decode_pos(buffers, delta):
+    """Add ``delta`` to every ``decode_pos`` leaf — the PER-ROW rewind
+    primitive of continuous-batching speculative decode. ``delta`` is a
+    ``(B,)`` array of (non-positive) offsets: each slot rolls its own
+    cache back to its own accepted boundary, where ``_set_decode_pos``
+    can only force one scalar across the batch."""
+    import jax.tree_util as jtu
+
+    def visit(path, leaf):
+        key = str(path[-1].key) if path and hasattr(path[-1], "key") else ""
+        if key == "decode_pos":
+            return leaf + delta.astype(leaf.dtype)
+        return leaf
+
+    return jtu.tree_map_with_path(visit, buffers)
+
+
 #: Buffer-tree leaf names that are PER-REQUEST prefill state (owned,
 #: donated, copied per admission) as opposed to shared model buffers
 #: (e.g. a quantized model's int8 weights — read-only across requests).

@@ -45,6 +45,28 @@ from bigdl_tpu.utils.util import pow2_bucket
 _REQUEST_IDS = itertools.count(1)
 
 
+def fail_requests(reqs, message: str, *, category: str) -> None:
+    """Fail stranded requests: set the error, release every blocked
+    ``submit()``, close the trace lifecycle. Shared by both serving
+    planes (this batcher and ``models/serving.py``) — the close/stop/
+    dead-server drains previously hand-rolled this loop five times."""
+    for req in reqs:
+        req.error = message
+        req.done.set()
+        tracing.async_end(category, req.rid, error=req.error)
+
+
+def drain_queue(q: "queue.Queue"):
+    """Empty a request queue without blocking; returns the drained items."""
+    out = []
+    while True:
+        try:
+            out.append(q.get_nowait())
+        except queue.Empty:
+            break
+    return out
+
+
 @dataclass
 class _Request:
     ids: List[int]                      # 1-based prompt token ids
@@ -143,18 +165,9 @@ class LMServer:
         # must not hang forever on a server that will never decode again
         with self._held_lock:
             stranded, self._held = self._held, []
-        for req in stranded:
-            req.error = "server closed before the request was dispatched"
-            req.done.set()
-            tracing.async_end("lmserver.request", req.rid, error=req.error)
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            req.error = "server closed before the request was dispatched"
-            req.done.set()
-            tracing.async_end("lmserver.request", req.rid, error=req.error)
+        fail_requests(stranded + drain_queue(self._queue),
+                      "server closed before the request was dispatched",
+                      category="lmserver.request")
 
     @property
     def batches_served(self) -> int:
@@ -212,11 +225,8 @@ class LMServer:
             try:
                 self._decode_batch(batch)
             except Exception as e:  # surface to every waiter, keep serving
-                for req in batch:
-                    req.error = f"{type(e).__name__}: {e}"
-                    req.done.set()
-                    tracing.async_end("lmserver.request", req.rid,
-                                      error=req.error)
+                fail_requests(batch, f"{type(e).__name__}: {e}",
+                              category="lmserver.request")
         # stop-path drain ON THE WORKER: close() sweeps _held and the
         # queue once after a BOUNDED join — when that join times out
         # (slow decode), this loop may hold or dequeue a request AFTER
@@ -224,15 +234,9 @@ class LMServer:
         # is ever stranded, whichever side runs last
         with self._held_lock:
             stranded, self._held = self._held, []
-        while True:
-            try:
-                stranded.append(self._queue.get_nowait())
-            except queue.Empty:
-                break
-        for req in stranded:
-            req.error = "server closed before the request was dispatched"
-            req.done.set()
-            tracing.async_end("lmserver.request", req.rid, error=req.error)
+        fail_requests(stranded + drain_queue(self._queue),
+                      "server closed before the request was dispatched",
+                      category="lmserver.request")
 
     def _decode_batch(self, batch: List[_Request]):
         import jax

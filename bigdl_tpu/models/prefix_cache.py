@@ -1,0 +1,228 @@
+"""Cross-request KV prefix cache for the continuous serving engine
+(round 9, ROADMAP #2's reuse half).
+
+At scale, serving traffic is dominated by SHARED PREFIXES — system
+prompts, few-shot templates — yet every admission to
+``ContinuousLMServer`` re-prefilled from token 0. This module caches the
+per-request prefill state partition (``generation.partition_prefill_state``
+output: b=1 KV caches + write position) at CHUNK boundaries of the
+chunked prefill, so a later admission sharing a chunk-aligned token
+prefix copies the cached partition and chunk-prefills only the uncached
+tail.
+
+Why chunk alignment, twice over:
+
+- **The snapshot is free.** Between two ``chunk_fn`` dispatches the
+  engine holds exactly the state partition the next chunk consumes —
+  the snapshot is that value, taken in flight (one device copy, and only
+  for prefixes the trie has not seen; known prefixes skip even that).
+  No re-slicing, no recompute, no extra program.
+- **Hits stay bit-identical.** Resuming a prefill from a chunk boundary
+  reproduces the cold run's exact chunk partition of the remaining
+  tokens — same fixed-width (1, C) dispatches, same floating-point
+  reduction groupings — so a hit admission's greedy output is
+  bit-identical to a cold prefill (asserted in tier-1). A mid-chunk
+  resume would regroup the tail's attention reductions and lose that
+  guarantee, which is why only FULL-chunk boundaries are cached.
+
+Structure: a radix trie over chunk-granular token paths, addressed by a
+ROLLING HASH — each stored node is one chunk-aligned prefix, keyed by
+the polynomial hash of its tokens, with the exact token tuple kept for
+collision rejection. Lookups never enumerate children (they descend by
+extending the hash one chunk at a time and probing deepest-first), so
+the trie stores its paths flat in one LRU-ordered map.
+
+Bounded by construction (graftlint JG014's discipline applied to KV
+instead of programs): ``max_bytes`` caps the held snapshot bytes, and
+overflow evicts LEAST-RECENTLY-USED entries one at a time — never
+clear-at-cap — with every eviction counted
+(``bigdl_prefix_cache_evictions``). All mutation holds the cache's own
+lock; the serving worker and a concurrent ``close()``/test probe can
+race admissions against evictions safely (JG015-017 stay green).
+
+The trie attaches to the MODEL (``model.__dict__["_prefix_trie"]``,
+keyed by (chunk, cache_len) config) so a re-created server over the
+same weights keeps its warm prefixes — and ``nn.Module.__getstate__``
+pops it, so deepcopy/pickle of a served model never drags cached KV
+(or this cache's thread lock, which does not pickle) along.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+__all__ = ["PrefixCache", "prefix_cache_for", "rolling_hash",
+           "DEFAULT_PREFIX_CACHE_MB"]
+
+#: Default held-snapshot budget (MiB) for one server's prefix trie.
+DEFAULT_PREFIX_CACHE_MB = 64.0
+
+# Polynomial rolling hash over 1-based token ids: extending a prefix by
+# one chunk extends its hash without rehashing the whole prefix. The
+# Mersenne modulus keeps Python ints small; collisions are survivable
+# (the stored token tuple is always verified) so 61 bits is plenty.
+_HASH_BASE = 1_000_003
+_HASH_MOD = (1 << 61) - 1
+
+
+def rolling_hash(tokens: Sequence[int], seed: int = 0) -> int:
+    """Extend ``seed`` (the hash of everything before ``tokens``) by the
+    given tokens — ``rolling_hash(b, rolling_hash(a)) ==
+    rolling_hash(a + b)``, the trie-descent identity."""
+    h = seed
+    for t in tokens:
+        h = (h * _HASH_BASE + int(t) + 1) % _HASH_MOD
+    return h
+
+
+class _Node:
+    """One stored chunk-aligned prefix: its exact tokens (collision
+    check), the owned state-partition snapshot, and its byte cost."""
+
+    __slots__ = ("tokens", "state", "nbytes")
+
+    def __init__(self, tokens: Tuple[int, ...], state: list, nbytes: int):
+        self.tokens = tokens
+        self.state = state
+        self.nbytes = nbytes
+
+
+class PrefixCache:
+    """Chunk-aligned prefix trie of prefill-state snapshots (module doc).
+
+    ``match``/``put`` return plain facts (hit depth, evictions
+    performed) and the cache keeps cumulative ``hits``/``misses``/
+    ``evictions`` counters; the serving engine mirrors those into its
+    metrics registry (this class stays registry-free so one trie can
+    serve successive servers with different registries).
+    """
+
+    def __init__(self, chunk: int, max_bytes: int):
+        if chunk < 1:
+            raise ValueError("chunk must be >= 1")
+        self.chunk = int(chunk)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        # rolling hash of the prefix -> _Node, in LRU order (oldest first)
+        self._entries: "OrderedDict[int, _Node]" = OrderedDict()
+        self.nbytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def boundaries(self) -> List[int]:
+        """Stored prefix depths (token counts), for tests/introspection."""
+        with self._lock:
+            return sorted(len(n.tokens) for n in self._entries.values())
+
+    # ---------------------------------------------------------------- lookup
+    def match(self, tokens: Sequence[int]):
+        """Deepest cached chunk-aligned prefix of ``tokens``.
+
+        Returns ``(depth, state_copy)`` — ``depth`` tokens are covered
+        by the returned OWNED copy (safe to donate straight into the
+        chunk program), or ``(0, None)`` on a miss. Probes boundaries
+        deepest-first so one hash walk prices the whole descent."""
+        c = self.chunk
+        tokens = [int(t) for t in tokens]
+        n_aligned = (len(tokens) // c) * c
+        probes: List[Tuple[int, int]] = []          # (depth, hash)
+        h = 0
+        for b in range(c, n_aligned + 1, c):
+            h = rolling_hash(tokens[b - c:b], h)
+            probes.append((b, h))
+        with self._lock:
+            for depth, h in reversed(probes):
+                node = self._entries.get(h)
+                if node is not None and node.tokens == tuple(tokens[:depth]):
+                    self._entries.move_to_end(h)
+                    self.hits += 1
+                    # copy INSIDE the lock (a concurrent eviction must not
+                    # drop the node mid-read); jnp.copy only dispatches —
+                    # no device sync is held here (JG017)
+                    import jax.numpy as jnp
+                    return depth, [jnp.copy(x) for x in node.state]
+            self.misses += 1
+        return 0, None
+
+    # ---------------------------------------------------------------- insert
+    def put(self, tokens: Sequence[int], state: list) -> int:
+        """Store a snapshot for the chunk-aligned prefix ``tokens``.
+
+        ``state`` is the LIVE partition between chunk dispatches; the
+        cache takes its own copy (the caller donates the live value to
+        the next program). Known prefixes are refreshed (LRU) without
+        copying. Returns the number of LRU evictions the insert forced
+        (0 usually); a snapshot larger than the whole budget is refused
+        rather than admitted-and-immediately-evicted."""
+        if len(tokens) % self.chunk != 0 or not tokens:
+            raise ValueError(
+                f"prefix length {len(tokens)} is not a whole number of "
+                f"chunks (chunk={self.chunk})")
+        key = tuple(int(t) for t in tokens)
+        h = rolling_hash(key)
+        with self._lock:
+            node = self._entries.get(h)
+            if node is not None and node.tokens == key:
+                self._entries.move_to_end(h)        # refresh, copy-free
+                return 0
+            import jax.numpy as jnp
+            nbytes = sum(int(getattr(x, "nbytes", 0)) for x in state)
+            if nbytes > self.max_bytes:
+                return 0
+            if node is not None:                    # hash collision: replace
+                self.nbytes -= node.nbytes
+            self._entries[h] = _Node(key, [jnp.copy(x) for x in state],
+                                     nbytes)
+            self.nbytes += nbytes
+            evicted = 0
+            while self.nbytes > self.max_bytes and len(self._entries) > 1:
+                # LRU single-entry eviction, counted — never clear-at-cap
+                # (the eviction-storm lesson from the compiled-program
+                # caches, JG014, applied to KV bytes)
+                _, old = self._entries.popitem(last=False)
+                self.nbytes -= old.nbytes
+                evicted += 1
+            self.evictions += evicted
+        return evicted
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.nbytes = 0
+
+    def __repr__(self) -> str:
+        return (f"PrefixCache(chunk={self.chunk}, entries={len(self)}, "
+                f"bytes={self.nbytes}/{self.max_bytes}, hits={self.hits}, "
+                f"misses={self.misses}, evictions={self.evictions})")
+
+
+def prefix_cache_for(model, *, chunk: int, cache_len: int,
+                     max_bytes: int) -> PrefixCache:
+    """Get-or-create the model's prefix trie for one prefill config.
+
+    Keyed by (chunk, cache_len) because a snapshot's leaves are shaped
+    by the prefill template — a server with a different chunk width or
+    cache length cannot consume another config's states. Attached to
+    ``model.__dict__`` so re-serving the same weights starts warm;
+    popped by ``Module.__getstate__`` so serialization never carries
+    cached KV. The per-model config dict is itself bounded (a config is
+    operator-chosen, not traffic-chosen, but nothing should grow
+    without a cap)."""
+    tries = model.__dict__.setdefault("_prefix_trie", OrderedDict())
+    key = (int(chunk), int(cache_len))
+    pc = tries.get(key)
+    if pc is None:
+        pc = PrefixCache(chunk, max_bytes)
+        tries[key] = pc
+        while len(tries) > 4:
+            tries.popitem(last=False)
+    else:
+        tries.move_to_end(key)
+        pc.max_bytes = int(max_bytes)   # latest server's budget wins
+    return pc

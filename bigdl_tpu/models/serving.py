@@ -33,6 +33,34 @@ scheduler, built TPU-first on static shapes):
 Dead slots keep computing garbage (their rows are never read) — the TPU
 trade: wasted lanes are cheaper than a recompile or a dynamic shape.
 
+Round 9 layers two first-class serving modes onto this engine:
+
+- CROSS-REQUEST KV PREFIX CACHE (``models/prefix_cache.py``, on by
+  default in chunked mode; ``BIGDL_PREFIX_CACHE=0`` disables): the
+  chunked prefill snapshots its per-request state partition at every
+  FULL chunk boundary into a per-model trie keyed by a rolling hash of
+  the chunk-aligned token prefix. An admission sharing a cached prefix
+  copies the b=1 partition and chunk-prefills only the uncached tail —
+  TTFT collapses on hits (``bigdl_serving_ttft_hit_seconds`` vs
+  ``_miss_``) while greedy outputs stay bit-identical to a cold prefill
+  (a chunk-boundary resume reproduces the cold run's exact chunk
+  partition, hence its exact floating-point reductions). Size-bounded
+  with counted LRU eviction.
+- SPECULATIVE DECODE (``draft=...``, ``BIGDL_SPEC_LEN``): the draft
+  model lives in its own (slots, L) continuous decode state, prefilled
+  and slot-inserted alongside the target on every admission. Each round
+  the draft proposes ``spec_len`` tokens per row (a ``lax.scan`` of
+  single-token steps) and the target verifies carried-token + proposals
+  in ONE multi-token continuous forward — the chunked verification path
+  (``nn.attention._attend_decode_continuous``'s chunk branch: per-row
+  write positions, per-row masks). Per-row first-mismatch acceptance
+  emits 1..spec_len+1 tokens per dispatch and rolls BOTH caches back to
+  each row's accepted boundary (a per-row ``decode_pos`` shift; the
+  stale writes sit behind the position mask until overwritten).
+  Greedy-only — acceptance is exact argmax match, which is what keeps
+  outputs bit-identical to the non-speculative path. ``decode_block``
+  is ignored in this mode: one round is one dispatch.
+
 Restrictions: rope models only (additive positional-encoding modules
 track a shared scalar position), no beam search. Sampling is the server's
 (greedy/temperature/top_k/top_p via ``generation.sample_token``).
@@ -58,9 +86,13 @@ import numpy as np
 
 from bigdl_tpu.nn.module import functional_apply
 from bigdl_tpu.models.generation import (_decode_modules,
+                                         _shift_decode_pos,
                                          build_bucketed_prefill_fn,
                                          build_chunked_prefill_fns,
                                          sample_token)
+from bigdl_tpu.models.lm_server import drain_queue, fail_requests
+from bigdl_tpu.models.prefix_cache import (DEFAULT_PREFIX_CACHE_MB,
+                                           prefix_cache_for)
 from bigdl_tpu.telemetry import get_registry, instruments, span, tracing
 from bigdl_tpu.telemetry.profiling import (sample_device_memory,
                                            tracked_jit)
@@ -97,6 +129,238 @@ class _Slot:
         self.new_count = 0
 
 
+def _build_insert_fn(registry):
+    """Jitted scatter of a prefilled b=1 cache into slot row ``slot``
+    (slot/plen are traced scalars, so ONE compile per buffer-tree
+    signature). Model-agnostic tree surgery — the same wrapper serves
+    the target insert and, in speculative mode, the draft insert as a
+    second signature."""
+    def insert_prog(big, small, slot, plen):
+        flat_b, treedef = jax.tree_util.tree_flatten_with_path(big)
+        flat_s = jax.tree_util.tree_flatten_with_path(small)[0]
+        out = []
+        for (kp, bg), (_, sm) in zip(flat_b, flat_s):
+            name = str(kp[-1])
+            if "k_cache" in name or "v_cache" in name:
+                # the chunked-prefill template cache is padded to a
+                # whole number of chunks; only the first max_len entries
+                # are live (anything past the prompt is masked pad
+                # garbage) — slice before the scatter (no-op when the
+                # template is not longer than the slot row; a spec-mode
+                # slot row carries spec_len+1 slack the template lacks,
+                # and the tail past the copy stays masked the same way)
+                out.append(jax.lax.dynamic_update_slice(
+                    bg, sm.astype(bg.dtype)[:, :bg.shape[1]],
+                    (slot,) + (0,) * (bg.ndim - 1)))
+            elif "decode_pos" in name:
+                out.append(jax.lax.dynamic_update_slice(
+                    bg, plen[None].astype(bg.dtype), (slot,)))
+            else:
+                out.append(bg)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return tracked_jit(insert_prog, site="serving.insert",
+                       registry=registry,
+                       donate_argnums=(0,))
+
+
+class _PrefillPipeline:
+    """The out-of-band b=1 admission-prefill machine for ONE model.
+
+    PR 15 built this inline for the target; speculative serving runs
+    the SAME admission prefill against the draft (its (slots, L)
+    continuous cache needs the prompt too), so the machinery — the
+    decode-mode templates, the O(1) program set, the trace-time flag
+    context, and now the prefix trie — lives here once and the server
+    instantiates it per model."""
+
+    def __init__(self, model, *, mode: str, chunk: int, slots: int,
+                 max_len: int, big_len: int, registry, site: str,
+                 prefix_bytes: int = 0):
+        mhas, pes, heads = _decode_modules(model)
+        if pes:
+            raise ValueError(
+                "continuous batching requires a rope model (additive "
+                "positional encodings track one shared position; "
+                "build_lm(rope=True))")
+        if not mhas:
+            raise ValueError("model has no attention layers to cache")
+        self.model = model
+        self.mhas, self.heads = mhas, heads
+        self.mode, self.chunk, self.max_len = mode, chunk, max_len
+        model.evaluate_mode()
+        # single-request decode template (the prefill signature) FIRST,
+        # then the persistent continuous state. The chunked template
+        # cache is padded up to a whole number of chunks so the final
+        # (right-padded) chunk's k/v write never clips against the cache
+        # end — the insert slices the copy back down to the slot row.
+        if mode == "chunked":
+            self.cache_len = -(-max_len // chunk) * chunk
+        else:
+            self.cache_len = max_len
+        for m in mhas:
+            m.enable_decode(1, self.cache_len)
+        for m in heads:
+            m.enable_decode()
+        _, small0 = model.functional_state()
+        # COPY the template leaves: non-cache buffers (e.g. a quantized
+        # model's int8 weights live in the buffer tree) are otherwise the
+        # very arrays the donating step/insert programs consume — the
+        # first admission would delete the prefill template's references
+        self.small_bufs0 = jax.tree_util.tree_map(jnp.copy, small0)
+        for m in mhas:
+            m.enable_decode(slots, big_len, continuous=True)
+        self.params, self.buffers = model.functional_state()
+        # the O(1) prefill program set, built BEFORE the worker thread
+        # starts (wrappers are cheap; XLA programs compile lazily inside
+        # tracked_jit at first dispatch, counted per signature in
+        # bigdl_compiles_total{site})
+        if mode == "chunked":
+            (self.chunk_fn, self.last_fn, self.state0,
+             self.statics, self.merge) = build_chunked_prefill_fns(
+                model, self.small_bufs0, site=site, registry=registry)
+            self.bucket_fn = None
+            # the cross-request prefix trie rides on the MODEL (warm
+            # prefixes survive a server restart over the same weights;
+            # __getstate__ pops it). Chunked mode only — bucketed
+            # prefill has no chunk-aligned snapshots to key on.
+            self.prefix = (prefix_cache_for(
+                model, chunk=chunk, cache_len=self.cache_len,
+                max_bytes=prefix_bytes) if prefix_bytes > 0 else None)
+        else:
+            self.chunk_fn = self.last_fn = None
+            self.bucket_fn = build_bucketed_prefill_fn(
+                model, site=site, registry=registry)
+            self.prefix = None
+
+    @property
+    def fns(self):
+        """The O(1) prefill program set — chunked mode holds the chunk +
+        last-token pair, bucketed mode one wrapper that specializes per
+        power-of-two bucket. Collapsed from the pre-PR-15 per-prompt-
+        length LRU (one program per distinct length, the compile storm
+        graftlint JG013's fire fixture preserves)."""
+        fns = {"chunk": self.chunk_fn, "last": self.last_fn,
+               "bucket": self.bucket_fn}
+        return {k: v for k, v in fns.items() if v is not None}
+
+    def single_mode(self, prefilled: bool, all_logits: bool = False):
+        """Context: flip the attention modules to single-request decode
+        semantics for tracing/running the b=1 prefill programs.
+
+        ``prefilled`` is the trace-time cache temperature: True traces
+        the warm-cache masked branch (chunked prefill — correct on a
+        cold cache too, the position mask excludes unwritten slots),
+        False the cold causal fast path (bucketed prefill, which always
+        starts from scratch). ``all_logits`` flips the LM heads to emit
+        every position (the bucketed program reads the true last token
+        at a traced index inside the padded bucket)."""
+        pipe = self
+
+        class _Ctx:
+            def __enter__(self):
+                for m in pipe.mhas:
+                    m._continuous = False
+                    m._decode_prefilled = prefilled
+                if all_logits:
+                    for h in pipe.heads:
+                        h._decode_all = True
+                return self
+
+            def __exit__(self, *a):
+                for m in pipe.mhas:
+                    m._continuous = True
+                    m._decode_prefilled = True
+                if all_logits:
+                    for h in pipe.heads:
+                        h._decode_all = False
+
+        return _Ctx()
+
+    def _prefill_chunked(self, ids: List[int]):
+        """Chunked b=1 prompt prefill: ⌈(L-1)/C⌉ fixed-width chunks that
+        write k/v at the true cache positions (final chunk right-padded,
+        pads masked and re-covered via the in-program ``decode_pos``
+        rewind), then ONE single-token step for the last prompt token
+        whose (1, V) log-probs feed the admission sample. Two compiled
+        programs total, any L — and with the prefix trie, only the
+        UNCACHED tail's chunks are dispatched on a hit."""
+        c = self.chunk
+        n = len(ids) - 1        # last token runs as the lp-producing step
+        hit = 0
+        state = None
+        if self.prefix is not None:
+            # deepest cached chunk-aligned prefix of the chunked portion
+            # (already an owned copy, safe to donate into the chunk loop)
+            hit, state = self.prefix.match(ids[:n])
+        if state is None:
+            # both prefill programs donate the per-request STATE
+            # partition (caches + positions — in-place updates across
+            # the chunk loop); hand them an OWNED copy so the template
+            # survives this admission. Shared buffers (a quantized
+            # model's int8 weights) ride along non-donated: the
+            # per-admission copy scales with the b=1 cache, never with
+            # model size.
+            state = [jnp.copy(x) for x in self.state0]
+        statics = self.statics
+        for start in range(hit, n, c):
+            valid = min(c, n - start)
+            chunk = np.ones((1, c), np.float32)   # pad id 1: any valid id
+            chunk[0, :valid] = ids[start:start + valid]
+            state = self.chunk_fn(self.params, state, statics,
+                                  jnp.asarray(chunk),
+                                  jnp.int32(start + valid))
+            if self.prefix is not None and valid == c:
+                # FULL-chunk boundary: the live state IS the snapshot —
+                # the trie copies it (known prefixes skip even the copy)
+                # before the next dispatch donates it away. Ragged final
+                # chunks are never cached: a mid-chunk resume would
+                # regroup the tail's reductions and break bit-exactness.
+                self.prefix.put(ids[:start + valid], state)
+        last = np.asarray([[ids[-1]]], np.float32)
+        lp, state = self.last_fn(self.params, state, statics,
+                                 jnp.asarray(last))
+        # the insert consumes the FULL small tree (structure must match
+        # the big tree leaf-for-leaf); merge is host-side, copy-free
+        return lp, self.merge(state, statics), hit
+
+    def _prefill_bucketed(self, ids: List[int]):
+        """Length-bucketed b=1 prompt prefill (fallback mode): the
+        prompt right-pads to its power-of-two bucket and runs the
+        standard cold causal prefill — one program per BUCKET
+        (O(log max_len) total), with the true last token's log-probs
+        read at a traced index."""
+        plen = len(ids)
+        cap = self.cache_len
+        bsz = pow2_bucket(plen, min(_PREFILL_BUCKET_LO, cap), cap)
+        prompt = np.ones((1, bsz), np.float32)
+        prompt[0, :plen] = ids
+        lp, bufs = self.bucket_fn(self.params, self.small_bufs0,
+                                  jnp.asarray(prompt), jnp.int32(plen - 1))
+        return lp, bufs, 0
+
+    def run(self, ids: List[int]):
+        """Mode dispatch + compile accounting: returns ``(lp, small
+        buffer tree, prefix-hit depth, programs built)`` — any program
+        the flight recorder built during this prefill counts as serving
+        recompile churn (per NEW SIGNATURE — a bucketed wrapper minting
+        its second bucket counts exactly like a fresh program build)."""
+        fns = self.fns
+        before = sum(fn.compiles for fn in fns.values())
+        if self.mode == "bucketed":
+            with self.single_mode(prefilled=False, all_logits=True):
+                lp, small, hit = self._prefill_bucketed(ids)
+        else:
+            with self.single_mode(prefilled=True):
+                lp, small, hit = self._prefill_chunked(ids)
+        built = sum(fn.compiles for fn in fns.values()) - before
+        return lp, small, hit, built
+
+    def disable(self):
+        for m in self.mhas + self.heads:
+            m.disable_decode()
+
+
 class ContinuousLMServer:
     """Slot-scheduled continuous-batching server over one rope LM."""
 
@@ -106,7 +370,10 @@ class ContinuousLMServer:
                  top_p: float = 0.0, greedy: bool = False,
                  eos_id: Optional[int] = None, seed: int = 0,
                  registry=None, prefill_mode: Optional[str] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 draft=None, spec_len: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_mb: Optional[float] = None):
         if slots < 1:
             raise ValueError("slots must be >= 1")
         # prompt prefill strategy (both O(1)-compile; ROADMAP #1):
@@ -132,22 +399,45 @@ class ContinuousLMServer:
         chunk = min(chunk, max_len)
         self.prefill_mode = mode
         self.prefill_chunk = chunk
+        # speculative decode config (mirroring the prefill levers:
+        # constructor args first, BIGDL_SPEC_* env as deployment default)
+        self.draft = draft
+        if draft is not None:
+            if draft is model:
+                raise ValueError(
+                    "draft must be a separate module instance (one module "
+                    "cannot hold two decode states at once)")
+            if not greedy:
+                raise ValueError(
+                    "speculative serving is greedy-only: acceptance is "
+                    "exact argmax match against the target, which is what "
+                    "keeps outputs bit-identical to non-speculative decode")
+            k = int(spec_len if spec_len is not None
+                    else os.environ.get("BIGDL_SPEC_LEN", "4"))
+            if k < 1:
+                raise ValueError("spec_len must be >= 1")
+            self.spec_len = k
+        else:
+            self.spec_len = 0
+        # prefix-cache config: on by default in chunked mode (the cache
+        # keys on chunk-aligned snapshots; bucketed prefill has none)
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "BIGDL_PREFIX_CACHE", "1").lower() not in (
+                    "0", "off", "false", "no")
+        mb = float(prefix_cache_mb if prefix_cache_mb is not None
+                   else os.environ.get("BIGDL_PREFIX_CACHE_MB",
+                                       str(DEFAULT_PREFIX_CACHE_MB)))
+        prefix_bytes = (int(mb * (1 << 20))
+                        if (prefix_cache and mode == "chunked") else 0)
+        self.prefix_cache_enabled = prefix_bytes > 0
         # telemetry (docs/OBSERVABILITY.md): TTFT / per-token latency /
         # queue depth / slot occupancy — the serving SLO surface, exposed
         # by make_http_server as GET /metrics
         self.registry = registry if registry is not None else get_registry()
         self._tm = instruments(self.registry)
         self._tm.serving_slots_total.set(slots)
-        mhas, pes, heads = _decode_modules(model)
-        if pes:
-            raise ValueError(
-                "continuous batching requires a rope model (additive "
-                "positional encodings track one shared position; "
-                "build_lm(rope=True))")
-        if not mhas:
-            raise ValueError("model has no attention layers to cache")
         self.model = model
-        self._mhas, self._heads = mhas, heads
         self.slots = slots
         self.max_len = max_len
         self.decode_block = max(1, int(decode_block))
@@ -168,45 +458,33 @@ class ContinuousLMServer:
         self._n_served = 0
         self._n_admitted = 0
 
-        model.evaluate_mode()
-        # single-request decode template (the prefill signature) FIRST,
-        # then the persistent continuous state. The chunked template
-        # cache is padded up to a whole number of chunks so the final
-        # (right-padded) chunk's k/v write never clips against the cache
-        # end — the insert slices the copy back down to max_len.
-        if mode == "chunked":
-            self._prefill_cache_len = -(-max_len // chunk) * chunk
+        # continuous caches carry spec_len+1 rows of length slack in
+        # speculative mode: a request finishing at max_len still runs a
+        # final verification chunk whose writes land up to spec_len
+        # positions past its last committed token (masked, then rolled
+        # back — but the cache must physically hold them)
+        big_len = max_len + (self.spec_len + 1 if draft is not None else 0)
+        self._pipeline = _PrefillPipeline(
+            model, mode=mode, chunk=chunk, slots=slots, max_len=max_len,
+            big_len=big_len, registry=self.registry,
+            site="serving.prefill", prefix_bytes=prefix_bytes)
+        self._mhas, self._heads = self._pipeline.mhas, self._pipeline.heads
+        self.params = self._pipeline.params
+        self.buffers = self._pipeline.buffers
+        if draft is not None:
+            self._d_pipeline = _PrefillPipeline(
+                draft, mode=mode, chunk=chunk, slots=slots,
+                max_len=max_len, big_len=big_len, registry=self.registry,
+                site="serving.draft_prefill", prefix_bytes=prefix_bytes)
+            self.d_params = self._d_pipeline.params
+            self.d_buffers = self._d_pipeline.buffers
         else:
-            self._prefill_cache_len = max_len
-        for m in mhas:
-            m.enable_decode(1, self._prefill_cache_len)
-        for m in heads:
-            m.enable_decode()
-        _, small0 = model.functional_state()
-        # COPY the template leaves: non-cache buffers (e.g. a quantized
-        # model's int8 weights live in the buffer tree) are otherwise the
-        # very arrays the donating step/insert programs consume — the
-        # first admission would delete the prefill template's references
-        self._small_bufs0 = jax.tree_util.tree_map(jnp.copy, small0)
-        for m in mhas:
-            m.enable_decode(slots, max_len, continuous=True)
-        self.params, self.buffers = model.functional_state()
-        # the O(1) prefill program set, built BEFORE the worker thread
-        # starts (wrappers are cheap; XLA programs compile lazily inside
-        # tracked_jit at first dispatch, counted per signature in
-        # bigdl_compiles_total{site="serving.prefill"})
-        if mode == "chunked":
-            (self._chunk_fn, self._last_fn, self._prefill_state0,
-             self._prefill_statics, self._prefill_merge) = \
-                build_chunked_prefill_fns(model, self._small_bufs0,
-                                          registry=self.registry)
-            self._bucket_fn = None
-        else:
-            self._chunk_fn = self._last_fn = None
-            self._bucket_fn = build_bucketed_prefill_fn(
-                model, registry=self.registry)
+            self._d_pipeline = None
+            self.d_params = self.d_buffers = None
         self._step_fn = None
         self._insert_fn = None
+        self._spec_fn = None
+        self._prefix_evictions_seen = 0
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._stop = threading.Event()
@@ -279,24 +557,17 @@ class ContinuousLMServer:
     def close(self):
         self._stop.set()
         self._worker.join(timeout=10)
-        for m in self._mhas + self._heads:
-            m.disable_decode()
+        for p in self._pipelines:
+            p.disable()
         with self._state_lock:
             stranded = list(self._active.values())
             self._active.clear()
-        for sl in stranded:
-            sl.req.error = "server closed mid-generation"
-            sl.req.done.set()
-            tracing.async_end("serving.request", sl.req.rid,
-                              error=sl.req.error)
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            req.error = "server closed before the request was dispatched"
-            req.done.set()
-            tracing.async_end("serving.request", req.rid, error=req.error)
+        fail_requests([sl.req for sl in stranded],
+                      "server closed mid-generation",
+                      category="serving.request")
+        fail_requests(drain_queue(self._queue),
+                      "server closed before the request was dispatched",
+                      category="serving.request")
 
     @property
     def batches_served(self) -> int:
@@ -304,141 +575,68 @@ class ContinuousLMServer:
 
     # ------------------------------------------------------------- programs
     @property
+    def _pipelines(self):
+        """The live prefill pipelines (target always; draft in
+        speculative mode)."""
+        return ([self._pipeline] if self._d_pipeline is None
+                else [self._pipeline, self._d_pipeline])
+
+    @property
     def _prefill_fns(self):
-        """The O(1) prefill program set — chunked mode holds the chunk +
-        last-token pair, bucketed mode one wrapper that specializes per
-        power-of-two bucket. Collapsed from the pre-PR-15 per-prompt-
-        length LRU (one program per distinct length, the compile storm
-        graftlint JG013's fire fixture preserves)."""
-        fns = {"chunk": self._chunk_fn, "last": self._last_fn,
-               "bucket": self._bucket_fn}
-        return {k: v for k, v in fns.items() if v is not None}
+        """The target's O(1) prefill program set (see
+        ``_PrefillPipeline.fns``)."""
+        return self._pipeline.fns
 
-    def _single_mode(self, prefilled: bool, all_logits: bool = False):
-        """Context: flip the attention modules to single-request decode
-        semantics for tracing/running the b=1 prefill programs.
-
-        ``prefilled`` is the trace-time cache temperature: True traces
-        the warm-cache masked branch (chunked prefill — correct on a
-        cold cache too, the position mask excludes unwritten slots),
-        False the cold causal fast path (bucketed prefill, which always
-        starts from scratch). ``all_logits`` flips the LM heads to emit
-        every position (the bucketed program reads the true last token
-        at a traced index inside the padded bucket)."""
-        server = self
-
-        class _Ctx:
-            def __enter__(self):
-                for m in server._mhas:
-                    m._continuous = False
-                    m._decode_prefilled = prefilled
-                if all_logits:
-                    for h in server._heads:
-                        h._decode_all = True
-                return self
-
-            def __exit__(self, *a):
-                for m in server._mhas:
-                    m._continuous = True
-                    m._decode_prefilled = True
-                if all_logits:
-                    for h in server._heads:
-                        h._decode_all = False
-
-        return _Ctx()
-
-    def _prefill_chunked(self, ids: List[int]):
-        """Chunked b=1 prompt prefill: ⌈(L-1)/C⌉ fixed-width chunks that
-        write k/v at the true cache positions (final chunk right-padded,
-        pads masked and re-covered via the in-program ``decode_pos``
-        rewind), then ONE single-token step for the last prompt token
-        whose (1, V) log-probs feed the admission sample. Two compiled
-        programs total, any L."""
-        c = self.prefill_chunk
-        # both prefill programs donate the per-request STATE partition
-        # (caches + positions — in-place updates across the chunk loop);
-        # hand them an OWNED copy so the template survives this
-        # admission. Shared buffers (a quantized model's int8 weights)
-        # ride along non-donated: the per-admission copy scales with the
-        # b=1 cache, never with model size.
-        state = [jnp.copy(x) for x in self._prefill_state0]
-        statics = self._prefill_statics
-        n = len(ids) - 1        # last token runs as the lp-producing step
-        for start in range(0, n, c):
-            valid = min(c, n - start)
-            chunk = np.ones((1, c), np.float32)   # pad id 1: any valid id
-            chunk[0, :valid] = ids[start:start + valid]
-            state = self._chunk_fn(self.params, state, statics,
-                                   jnp.asarray(chunk),
-                                   jnp.int32(start + valid))
-        last = np.asarray([[ids[-1]]], np.float32)
-        lp, state = self._last_fn(self.params, state, statics,
-                                  jnp.asarray(last))
-        # the insert consumes the FULL small tree (structure must match
-        # the big tree leaf-for-leaf); merge is host-side, copy-free
-        return lp, self._prefill_merge(state, statics)
-
-    def _prefill_bucketed(self, ids: List[int]):
-        """Length-bucketed b=1 prompt prefill (fallback mode): the
-        prompt right-pads to its power-of-two bucket and runs the
-        standard cold causal prefill — one program per BUCKET
-        (O(log max_len) total), with the true last token's log-probs
-        read at a traced index."""
-        plen = len(ids)
-        cap = self._prefill_cache_len
-        bsz = pow2_bucket(plen, min(_PREFILL_BUCKET_LO, cap), cap)
-        prompt = np.ones((1, bsz), np.float32)
-        prompt[0, :plen] = ids
-        return self._bucket_fn(self.params, self._small_bufs0,
-                               jnp.asarray(prompt), jnp.int32(plen - 1))
+    @property
+    def _prefill_cache_len(self):
+        """Template cache length of the target prefill pipeline."""
+        return self._pipeline.cache_len
 
     def _run_prefill(self, ids: List[int]):
-        """Mode dispatch + compile accounting: any program the flight
-        recorder built during this prefill counts as serving recompile
-        churn (per NEW SIGNATURE — a bucketed wrapper minting its
-        second bucket counts exactly like a fresh program build)."""
-        fns = self._prefill_fns
-        before = sum(fn.compiles for fn in fns.values())
-        if self.prefill_mode == "bucketed":
-            with self._single_mode(prefilled=False, all_logits=True):
-                out = self._prefill_bucketed(ids)
-        else:
-            with self._single_mode(prefilled=True):
-                out = self._prefill_chunked(ids)
-        built = sum(fn.compiles for fn in fns.values()) - before
+        """Admission prefill across every pipeline: the target produces
+        the sampling log-probs; in speculative mode the DRAFT prefills
+        the same prompt right after (its continuous cache needs the
+        prompt too — each pipeline keeps its own prefix trie over its
+        own state shapes, so a hot prefix skips chunks for both).
+        Compile accounting: any program the flight recorder built during
+        this prefill counts as serving recompile churn (per NEW
+        SIGNATURE — a bucketed wrapper minting its second bucket counts
+        exactly like a fresh program build)."""
+        lp, small, hit, built = self._pipeline.run(ids)
+        d_small = None
+        if self._d_pipeline is not None:
+            _d_lp, d_small, _d_hit, d_built = self._d_pipeline.run(ids)
+            built += d_built
         if built:
             self._tm.serving_recompiles_total.inc(built)
-        return out
+        self._sync_prefix_metrics(hit)
+        return lp, small, d_small, hit
+
+    def _sync_prefix_metrics(self, hit: int) -> None:
+        """Mirror the trie's plain counters into the registry families.
+        Hit/miss count ADMISSIONS (the target trie's verdict — one count
+        per prefill, so hit rate reads directly as hits/(hits+misses));
+        evictions and held bytes aggregate over both pipelines' tries in
+        speculative mode."""
+        caches = [p.prefix for p in self._pipelines
+                  if p.prefix is not None]
+        if not caches:
+            return
+        (self._tm.prefix_cache_hits if hit
+         else self._tm.prefix_cache_misses).inc()
+        ev = sum(pc.evictions for pc in caches)
+        if ev > self._prefix_evictions_seen:
+            self._tm.prefix_cache_evictions.inc(
+                ev - self._prefix_evictions_seen)
+            self._prefix_evictions_seen = ev
+        self._tm.prefix_cache_bytes.set(sum(pc.nbytes for pc in caches))
 
     def _insert(self):
-        """Jitted scatter of a prefilled b=1 cache into slot row ``slot``
-        (one compile total: slot/plen are traced scalars)."""
+        """The slot-insert program (built on first use; the draft insert
+        in speculative mode is the SAME wrapper specializing on the
+        draft's buffer-tree signature)."""
         if self._insert_fn is None:
-            def run(big, small, slot, plen):
-                flat_b, treedef = jax.tree_util.tree_flatten_with_path(big)
-                flat_s = jax.tree_util.tree_flatten_with_path(small)[0]
-                out = []
-                for (kp, bg), (_, sm) in zip(flat_b, flat_s):
-                    name = str(kp[-1])
-                    if "k_cache" in name or "v_cache" in name:
-                        # the chunked-prefill template cache is padded to
-                        # a whole number of chunks; only the first
-                        # max_len entries are live (anything past the
-                        # prompt is masked pad garbage) — slice before
-                        # the scatter (no-op when lengths already match)
-                        out.append(jax.lax.dynamic_update_slice(
-                            bg, sm.astype(bg.dtype)[:, :bg.shape[1]],
-                            (slot,) + (0,) * (bg.ndim - 1)))
-                    elif "decode_pos" in name:
-                        out.append(jax.lax.dynamic_update_slice(
-                            bg, plen[None].astype(bg.dtype), (slot,)))
-                    else:
-                        out.append(bg)
-                return jax.tree_util.tree_unflatten(treedef, out)
-
-            self._insert_fn = tracked_jit(run, site="serving.insert",
-                                          registry=self.registry,
-                                          donate_argnums=(0,))
+            self._insert_fn = _build_insert_fn(self.registry)
             self._tm.serving_recompiles_total.inc()
         return self._insert_fn
 
@@ -468,6 +666,92 @@ class ContinuousLMServer:
             self._tm.serving_recompiles_total.inc()
         return self._step_fn
 
+    def _spec(self):
+        """Jitted speculative round over ALL slots: the draft proposes
+        ``spec_len`` tokens per row (a scan of single-token continuous
+        steps; one extra step commits the last proposal's k/v), the
+        target verifies carried-token + proposals in ONE multi-token
+        continuous forward (``_attend_decode_continuous``'s chunk
+        branch — the chunked verification path), and per-row
+        first-mismatch acceptance emits 1..spec_len+1 tokens. Both
+        caches then roll back PER ROW to the accepted boundary
+        (``_shift_decode_pos``); the rejected writes sit behind the
+        position mask until the next round overwrites them. Greedy ids
+        are argmax+1 — exactly ``sample_token(greedy=True)`` — so the
+        accepted stream is bit-identical to the non-speculative path."""
+        if self._spec_fn is None:
+            target = self.model
+            draft = self.draft
+            k = self.spec_len
+
+            def run(params, bufs, d_params, d_bufs, toks):
+                def propose(carry, _):
+                    db, tok = carry
+                    lp, db = functional_apply(
+                        draft, d_params, db,
+                        tok[:, None].astype(jnp.float32), training=False)
+                    nxt = (jnp.argmax(lp[:, -1], axis=-1)
+                           + 1).astype(jnp.int32)
+                    return (db, nxt), nxt
+
+                # k+1 draft steps: step i consumes proposal i-1; the
+                # final step's OUTPUT is discarded but its input write
+                # commits proposal k's k/v (kept on acceptance, rolled
+                # back with everything else on rejection)
+                (d_bufs, _), props = jax.lax.scan(
+                    propose, (d_bufs, toks), None, length=k + 1)
+                d_props = props[:k].T                      # (slots, k)
+                chunk = jnp.concatenate([toks[:, None], d_props], axis=1)
+                lp, bufs = functional_apply(
+                    target, params, bufs, chunk.astype(jnp.float32),
+                    training=False)
+                g = (jnp.argmax(lp, axis=-1) + 1).astype(jnp.int32)
+                match = d_props == g[:, :k]
+                # first mismatch per row; k when the whole draft matched
+                # (the appended False column is argmin's sentinel)
+                n_acc = jnp.argmin(jnp.concatenate(
+                    [match, jnp.zeros((match.shape[0], 1), bool)],
+                    axis=1).astype(jnp.int32), axis=1)
+                bonus = jnp.take_along_axis(g, n_acc[:, None],
+                                            axis=1)[:, 0]
+                ar = jnp.arange(k + 1)[None, :]
+                props_pad = jnp.concatenate(
+                    [d_props, jnp.zeros((d_props.shape[0], 1),
+                                        jnp.int32)], axis=1)
+                emit = jnp.where(ar < n_acc[:, None], props_pad,
+                                 bonus[:, None])
+                n_emit = n_acc + 1
+                # both models advanced decode_pos by k+1; roll each row
+                # back to its own accepted boundary
+                delta = n_emit - (k + 1)
+                bufs = _shift_decode_pos(bufs, delta)
+                d_bufs = _shift_decode_pos(d_bufs, delta)
+                return emit, n_emit, bonus, bufs, d_bufs
+
+            self._spec_fn = tracked_jit(run, site="serving.spec_step",
+                                        registry=self.registry,
+                                        donate_argnums=(1, 3))
+            self._tm.serving_recompiles_total.inc()
+        return self._spec_fn
+
+    def _spec_round(self):
+        """Dispatch one speculative round with the TARGET heads in
+        all-positions mode — a trace-time flag (only the FIRST call per
+        signature traces, but flipping around every dispatch is a few
+        attribute writes). The draft heads stay last-sliced: its scan
+        steps are single-token."""
+        for h in self._heads:
+            h._decode_all = True
+        try:
+            emit, n_emit, cur, bufs, d_bufs = self._spec()(
+                self.params, self.buffers, self.d_params, self.d_buffers,
+                jnp.asarray(self._last_tok))
+        finally:
+            for h in self._heads:
+                h._decode_all = False
+        return (np.asarray(emit), np.asarray(n_emit),
+                np.asarray(cur).astype(np.int32), bufs, d_bufs)
+
     # --------------------------------------------------------------- worker
     def _admit(self, req: _Request) -> bool:
         plen = len(req.ids)
@@ -479,7 +763,7 @@ class ContinuousLMServer:
         try:
             with span("serving.prefill", plen=plen, rid=req.rid,
                       mode=self.prefill_mode):
-                lp, small = self._run_prefill(req.ids)
+                lp, small, d_small, hit = self._run_prefill(req.ids)
                 # key advances per ADMISSION (not per completion — several
                 # admits can happen between completions, and identical
                 # prompts sampled under a reused key would correlate
@@ -497,6 +781,13 @@ class ContinuousLMServer:
             with span("serving.insert", slot=slot, rid=req.rid):
                 self.buffers = self._insert()(
                     self.buffers, small, jnp.int32(slot), jnp.int32(plen))
+                if d_small is not None:
+                    # the draft cache needs the prompt too (same wrapper,
+                    # second signature); its decode_pos lands on the same
+                    # plen so both models enter the round at position P
+                    self.d_buffers = self._insert()(
+                        self.d_buffers, d_small, jnp.int32(slot),
+                        jnp.int32(plen))
             with self._state_lock:
                 self._free.pop()
             tracing.async_instant("serving.request", req.rid,
@@ -505,8 +796,13 @@ class ContinuousLMServer:
             # watermark sampling points (the other is the step boundary)
             sample_device_memory(self.registry)
             # first token sampled == time-to-first-token for this request
-            self._tm.serving_ttft_seconds.observe(
-                time.perf_counter() - req.t_submit)
+            ttft = time.perf_counter() - req.t_submit
+            self._tm.serving_ttft_seconds.observe(ttft)
+            if self.prefix_cache_enabled:
+                # the hit/miss TTFT split is the prefix cache's headline
+                # effect — p50(hit) / p50(miss) is the scoreboard column
+                (self._tm.serving_ttft_hit_seconds if hit
+                 else self._tm.serving_ttft_miss_seconds).observe(ttft)
             self._tm.serving_admissions_total.inc()
             self._tm.serving_tokens_total.inc()
             sl = _Slot(req)
@@ -559,21 +855,14 @@ class ContinuousLMServer:
             stranded = list(self._active.items())
             self._active.clear()
             self._free.extend(slot for slot, _ in stranded)
-        for _slot, sl in stranded:
-            sl.req.error = f"server died: {reason}"
-            sl.req.done.set()
-            tracing.async_end("serving.request", sl.req.rid,
-                              error=sl.req.error)
+        fail_requests([sl.req for _s, sl in stranded],
+                      f"server died: {reason}",
+                      category="serving.request")
         self._tm.serving_slots_occupied.set(0)
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            req.error = f"server is dead: {reason}"
-            req.done.set()
-            tracing.async_end("serving.request", req.rid, error=req.error)
-            self._tm.serving_request_errors_total.inc()
+        queued = drain_queue(self._queue)
+        fail_requests(queued, f"server is dead: {reason}",
+                      category="serving.request")
+        self._tm.serving_request_errors_total.inc(len(queued))
         self._tm.serving_queue_depth.set(0)
 
     def _run(self):
@@ -595,19 +884,12 @@ class ContinuousLMServer:
             stranded = list(self._active.items())
             self._active.clear()
             self._free.extend(s for s, _ in stranded)
-        for _slot, sl in stranded:
-            sl.req.error = "server closed mid-generation"
-            sl.req.done.set()
-            tracing.async_end("serving.request", sl.req.rid,
-                              error=sl.req.error)
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            req.error = "server closed before the request was dispatched"
-            req.done.set()
-            tracing.async_end("serving.request", req.rid, error=req.error)
+        fail_requests([sl.req for _s, sl in stranded],
+                      "server closed mid-generation",
+                      category="serving.request")
+        fail_requests(drain_queue(self._queue),
+                      "server closed before the request was dispatched",
+                      category="serving.request")
 
     def _serve_loop(self):
         while not self._stop.is_set():
@@ -629,9 +911,12 @@ class ContinuousLMServer:
                     continue
                 self._admit(req)
                 continue
-            # one decode block for every slot (dead rows compute garbage)
+            # one decode round for every slot (dead rows compute garbage):
+            # a decode_block scan of single-token steps, or in speculative
+            # mode one draft+verify round emitting 1..spec_len+1 tokens
+            # per row
             self._steps += 1
-            key = jax.random.fold_in(self._step_key, self._steps)
+            counts = None           # spec mode: per-row emit counts
             try:
                 t_block = time.perf_counter()
                 with span("serving.decode_block",
@@ -641,10 +926,16 @@ class ContinuousLMServer:
                         # list built only when the tracer is on)
                         sp.annotate(rids=[sl.req.rid
                                           for sl in self._active.values()])
-                    toks, self.buffers = self._step()(
-                        self.params, self.buffers,
-                        jnp.asarray(self._last_tok), key)
-                    toks = np.asarray(toks)
+                    if self.draft is not None:
+                        (toks, counts, cur,
+                         self.buffers, self.d_buffers) = self._spec_round()
+                    else:
+                        key = jax.random.fold_in(self._step_key,
+                                                 self._steps)
+                        toks, self.buffers = self._step()(
+                            self.params, self.buffers,
+                            jnp.asarray(self._last_tok), key)
+                        toks = np.asarray(toks)
             except Exception as e:  # noqa: BLE001 — fail fast AND dead
                 # a decode-step failure fails every in-flight request NOW
                 # (clients see the error instead of hanging to their
@@ -655,19 +946,34 @@ class ContinuousLMServer:
                 # immediately (ADVICE medium finding, serving.py:302).
                 self._die(f"decode step failed: {type(e).__name__}: {e}")
                 return
-            # per-token latency: block wall-clock (np.asarray is the host
-            # sync) amortized over the block — one observation per block
-            # keeps the hot loop at a few locked ops per decode_block
-            # tokens, not per token
+            live = list(self._active.keys())
+            # per-token latency: round wall-clock (np.asarray is the host
+            # sync) amortized over the tokens the round produced — fixed
+            # decode_block, or the measured mean emit count of live rows
+            # in speculative mode (the acceptance rate is what makes the
+            # round worth its dispatch)
+            per_round = (self.decode_block if counts is None
+                         else float(np.mean(counts[live])))
             self._tm.serving_token_latency_seconds.observe(
-                (time.perf_counter() - t_block) / self.decode_block)
+                (time.perf_counter() - t_block) / per_round)
             self._tm.serving_decode_blocks_total.inc()
+            if counts is not None:
+                # each live row was proposed spec_len draft tokens and
+                # accepted counts-1 of them (the +1 is the target's own
+                # bonus token, not a draft acceptance)
+                self._tm.spec_proposed_tokens_total.inc(
+                    self.spec_len * len(live))
+                self._tm.spec_accepted_tokens_total.inc(
+                    int(counts[live].sum()) - len(live))
             sample_device_memory(self.registry)
-            self._last_tok = toks[:, -1].astype(np.int32)
+            self._last_tok = (cur if counts is not None
+                              else toks[:, -1].astype(np.int32))
             eos = self.eos_id
             live_tokens = 0
             for slot, sl in list(self._active.items()):
-                for t in toks[slot]:
+                row = (toks[slot] if counts is None
+                       else toks[slot][:counts[slot]])
+                for t in row:
                     t = int(t)
                     sl.emitted.append(t)
                     sl.new_count += 1

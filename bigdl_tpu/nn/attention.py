@@ -338,29 +338,56 @@ class MultiHeadAttention(Module):
         return ctx.reshape(b, 1, h, d)
 
     def _attend_decode_continuous(self, q, k, v):
-        """Single-token step with PER-ROW cache positions (continuous
-        batching, ``models/serving.py``): row b writes its k/v at
-        ``decode_pos[b]`` and attends keys ``<= decode_pos[b]`` — every
-        slot lives at its own point in its own sequence. Prefill rows are
-        inserted out-of-band, so this path only ever sees s == 1."""
+        """Decode step with PER-ROW cache positions (continuous batching,
+        ``models/serving.py``): row b writes its k/v starting at
+        ``decode_pos[b]`` and query i of row b attends keys
+        ``<= decode_pos[b] + i`` — every slot lives at its own point in
+        its own sequence. ``s == 1`` is the steady-state token step;
+        ``s > 1`` is a per-row warm CHUNK — the chunked-verification
+        path speculative serving runs (draft proposals + the carried
+        token verified in one forward), the continuous twin of
+        ``_attend_decode``'s multi-token branch. Prefill rows are still
+        inserted out-of-band by the engine."""
         from bigdl_tpu.ops import attention_core
-        if q.shape[1] != 1:
-            raise ValueError("continuous decode steps are single-token "
-                             "(prefill is inserted per-slot by the engine)")
         pos = self.decode_pos                                    # (B,)
-        bsz = q.shape[0]
+        bsz, s = q.shape[0], q.shape[1]
         rows = jnp.arange(bsz)
-        self.k_cache = self.k_cache.at[rows, pos].set(
-            k[:, 0].astype(self.k_cache.dtype))
-        self.v_cache = self.v_cache.at[rows, pos].set(
-            v[:, 0].astype(self.v_cache.dtype))
-        self.decode_pos = pos + 1
+        if s == 1:
+            self.k_cache = self.k_cache.at[rows, pos].set(
+                k[:, 0].astype(self.k_cache.dtype))
+            self.v_cache = self.v_cache.at[rows, pos].set(
+                v[:, 0].astype(self.v_cache.dtype))
+        else:
+            # chunk scatter: row b's tokens land at pos[b]..pos[b]+s-1
+            idx = pos[:, None] + jnp.arange(s)[None, :]          # (B, S)
+            self.k_cache = self.k_cache.at[rows[:, None], idx].set(
+                k.astype(self.k_cache.dtype))
+            self.v_cache = self.v_cache.at[rows[:, None], idx].set(
+                v.astype(self.v_cache.dtype))
+        self.decode_pos = pos + s
         length = self.k_cache.shape[1]
+        n_kv = self.k_cache.shape[2]
+        if s > 1:
+            # chunk mask: query i of row b admits keys <= pos[b] + i.
+            # Kept OFF the steady-state trace: the (B, S, L) rank-3 mask
+            # measurably slows the single-token program's fusion, and
+            # s == 1 is the path every non-speculative decode token runs
+            k_pos = jnp.arange(length)[None, None, :]            # (1,1,L)
+            q_pos = pos[:, None] + jnp.arange(s)[None, :]        # (B, S)
+            valid = k_pos <= q_pos[:, :, None]                   # (B,S,L)
+            if getattr(self, "window", None):
+                valid = valid & (k_pos > q_pos[:, :, None] - self.window)
+            # expand GQA caches for this call too — chunks are rare
+            # relative to the steady state, same trade as
+            # ``_attend_decode``'s chunk branch
+            return attention_core.dot_product_attention(
+                q, self._expand_kv(self.k_cache),
+                self._expand_kv(self.v_cache),
+                mask=valid[:, None, :, :], causal=False)
         k_pos = jnp.arange(length)[None, :]                      # (1, L)
         valid = k_pos <= pos[:, None]                            # (B, L)
         if getattr(self, "window", None):
             valid = valid & (k_pos > pos[:, None] - self.window)
-        n_kv = self.k_cache.shape[2]
         if n_kv == self.num_heads:
             return attention_core.dot_product_attention(
                 q, self._expand_kv(self.k_cache),

@@ -303,11 +303,24 @@ class Module:
     def clone_module(self) -> "Module":
         return copy.deepcopy(self)
 
+    # Per-instance attachment caches that must NEVER serialize or deepcopy
+    # with the module: compiled-program caches (jit wrappers hold live XLA
+    # executables) and the serving prefix trie (holds a threading.Lock —
+    # unpicklable — plus cached KV snapshots that would silently multiply
+    # a checkpoint or a clone_module() by the cache size). Every site that
+    # attaches a cache via ``model.__dict__`` must list it here; the
+    # serialization regression test walks this tuple.
+    _EPHEMERAL_CACHES = (
+        "_jit_forward",    # nn.module: per-signature forward programs
+        "_generate_fns",   # models.generation: decode program LRU
+        "_spec_fns",       # models.generation: speculative-decode programs
+        "_prefix_trie",    # models.prefix_cache: cross-request KV snapshots
+    )
+
     def __getstate__(self):
         d = self.__dict__.copy()
-        d.pop("_jit_forward", None)  # jit wrappers don't serialize/deepcopy
-        d.pop("_generate_fns", None)
-        d.pop("_spec_fns", None)  # speculative-decode program cache
+        for key in self._EPHEMERAL_CACHES:
+            d.pop(key, None)
         return d
 
     # ----------------------------------------------------- parameter flatten

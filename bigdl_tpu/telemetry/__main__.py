@@ -218,7 +218,10 @@ def cmd_scoreboard(args) -> int:
             max_new=args.max_new, vocab=args.vocab, embed=args.embed,
             heads=args.heads, ffn=args.ffn, layers=args.layers,
             timeout=args.timeout, prefill_mode=args.prefill_mode,
-            prefill_chunk=args.prefill_chunk)
+            prefill_chunk=args.prefill_chunk, workload=args.workload,
+            templates=args.templates, template_len=args.template_len,
+            prefix_cache=(args.prefix_cache == "on"), draft=args.draft,
+            spec_len=args.spec_len)
         artifact = sb.run(cfg)
     body = json.dumps(artifact, indent=2)
     if args.out:
@@ -300,6 +303,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "length buckets)")
     ps.add_argument("--prefill-chunk", type=int, dest="prefill_chunk",
                     default=16, help="chunked-mode chunk width")
+    ps.add_argument("--workload", choices=("zipf", "shared-prefix"),
+                    default="zipf",
+                    help="zipf (default): mixed-length random prompts; "
+                         "shared-prefix: Zipf draws over a small pool of "
+                         "long shared templates + unique tails (the "
+                         "prefix-cache stress profile)")
+    ps.add_argument("--templates", type=int, default=4,
+                    help="shared-prefix: template pool size")
+    ps.add_argument("--template-len", type=int, dest="template_len",
+                    default=48, help="shared-prefix: shared-prefix length "
+                                     "in tokens")
+    ps.add_argument("--prefix-cache", dest="prefix_cache",
+                    choices=("on", "off"), default="on",
+                    help="cross-request KV prefix cache (chunked prefill)")
+    ps.add_argument("--draft", nargs="?", const="identical", default=None,
+                    choices=("identical", "int8"),
+                    help="speculative decode: 'identical' (same-weights "
+                         "draft — the acceptance-rate ceiling) or 'int8' "
+                         "(quantized-twin self-speculation)")
+    ps.add_argument("--spec-len", type=int, dest="spec_len", default=4,
+                    help="draft tokens proposed per speculative round")
     ps.add_argument("--out", default="",
                     help="write the JSON artifact here (default: stdout)")
     ps.add_argument("--markdown", action="store_true",

@@ -55,6 +55,38 @@ METRIC_SPECS: List[MetricSpec] = [
     MetricSpec("bigdl_serving_tokens_total", "counter",
                "Tokens emitted to live requests (dead-slot lanes "
                "excluded)."),
+    MetricSpec("bigdl_serving_ttft_hit_seconds", "histogram",
+               "TTFT of admissions whose prefix-cache lookup hit "
+               "(>= one chunk of prefill skipped). Only populated while "
+               "the prefix cache is enabled.",
+               (), DEFAULT_LATENCY_BUCKETS),
+    MetricSpec("bigdl_serving_ttft_miss_seconds", "histogram",
+               "TTFT of admissions that prefilled cold (prefix-cache "
+               "miss). Only populated while the prefix cache is enabled.",
+               (), DEFAULT_LATENCY_BUCKETS),
+    # ---- cross-request KV prefix cache (models/prefix_cache.py)
+    MetricSpec("bigdl_prefix_cache_hits", "counter",
+               "Admissions whose chunk-aligned token prefix matched a "
+               "cached prefill-state snapshot (tail-only prefill)."),
+    MetricSpec("bigdl_prefix_cache_misses", "counter",
+               "Admissions that found no cached chunk-aligned prefix and "
+               "prefilled from token 0."),
+    MetricSpec("bigdl_prefix_cache_evictions", "counter",
+               "Prefix-state snapshots dropped LRU-first from the "
+               "size-bounded trie, counted one entry at a time (never "
+               "clear-at-cap)."),
+    MetricSpec("bigdl_prefix_cache_bytes", "gauge",
+               "Bytes of prefill-state snapshots currently held by the "
+               "serving prefix trie(s) (target + draft in speculative "
+               "mode)."),
+    # ---- speculative serving (models/serving.py draft=...)
+    MetricSpec("bigdl_spec_proposed_tokens_total", "counter",
+               "Draft tokens proposed by speculative serving rounds "
+               "(spec_len per live slot per round)."),
+    MetricSpec("bigdl_spec_accepted_tokens_total", "counter",
+               "Draft proposals accepted by target verification (the "
+               "per-round bonus token is not counted, so accept rate = "
+               "accepted / proposed)."),
     # ---- bucketed batch server (models/lm_server.py)
     MetricSpec("bigdl_lmserver_batch_size", "histogram",
                "Requests per dispatched batch (pre-padding).",

@@ -23,7 +23,12 @@ Three modes behind ``python -m bigdl_tpu.telemetry scoreboard`` /
 Workload determinism: prompt lengths are drawn from a Zipf-weighted
 rank distribution over [lmin, lmax] and token ids uniformly from the
 vocab, all under one ``random.Random(seed)`` — two runs of the same
-config submit byte-identical prompts in the same order.
+config submit byte-identical prompts in the same order. Round 9 adds
+``workload="shared-prefix"`` (Zipf draws over a small pool of long
+shared templates + unique tails — the prefix-cache stress profile) and
+the serving-mode levers ``prefix_cache``/``draft``/``spec_len``, with
+``prefix_hit_rate``, ``spec_accept_rate`` and the hit/miss TTFT split
+as new row columns.
 
 jax-free at import (scrape/diff must run on a bare host); the run mode
 lazy-imports the model/server stack.
@@ -38,9 +43,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["ScoreboardConfig", "zipf_lengths", "make_prompts", "run",
-           "scrape", "render_markdown", "diff", "DEFAULT_THRESHOLDS",
-           "quantile_from_snapshot"]
+__all__ = ["ScoreboardConfig", "zipf_lengths", "make_prompts",
+           "shared_prefix_prompts", "run", "scrape", "render_markdown",
+           "diff", "DEFAULT_THRESHOLDS", "quantile_from_snapshot"]
 
 SCHEMA = 1
 DEFAULT_SLOTS = (8, 16, 32)
@@ -71,7 +76,10 @@ class ScoreboardConfig:
                  vocab: int = 256, embed: int = 32, heads: int = 2,
                  ffn: int = 64, layers: int = 2,
                  timeout: float = 600.0, prefill_mode: str = "chunked",
-                 prefill_chunk: int = 16):
+                 prefill_chunk: int = 16, workload: str = "zipf",
+                 templates: int = 4, template_len: int = 48,
+                 prefix_cache: bool = True, draft: bool = False,
+                 spec_len: int = 4):
         self.slots = [int(s) for s in slots]
         self.requests = int(requests)
         self.clients = max(1, int(clients))
@@ -89,19 +97,58 @@ class ScoreboardConfig:
         # workload still exercises a multi-chunk prompt now and then
         self.prefill_mode = str(prefill_mode)
         self.prefill_chunk = int(prefill_chunk)
-        self.max_len = self.lmax + self.max_new + 8
+        # workload "zipf" (the legacy mixed-length draw) or
+        # "shared-prefix": Zipf draws over a small pool of LONG shared
+        # templates plus unique random tails — the prefix-cache stress
+        # profile (real traffic: few system prompts, many continuations)
+        if workload not in ("zipf", "shared-prefix"):
+            raise ValueError(f"workload must be 'zipf' or 'shared-prefix',"
+                             f" got {workload!r}")
+        self.workload = workload
+        self.templates = int(templates)
+        self.template_len = int(template_len)
+        # serving-mode levers under measurement: cross-request prefix
+        # cache (chunked mode) and speculative decode. Draft modes:
+        # "identical" = a same-seed copy of the target (the acceptance-
+        # rate CEILING, 1.0 by construction), "int8" = a quantized twin
+        # (self-speculation — the acceptance an actual deployment
+        # pattern measures; ~0.95+ on the seeded workload). bool stays
+        # accepted for compatibility (True == "identical").
+        self.prefix_cache = bool(prefix_cache)
+        if draft in (False, None, ""):
+            self.draft = None
+        elif draft in (True, "identical"):
+            self.draft = "identical"
+        elif draft == "int8":
+            self.draft = "int8"
+        else:
+            raise ValueError(f"draft must be False, 'identical' or "
+                             f"'int8', got {draft!r}")
+        self.spec_len = int(spec_len)
+        tpl = self.template_len if workload == "shared-prefix" else 0
+        self.max_len = tpl + self.lmax + self.max_new + 8
 
     def workload_dict(self) -> dict:
-        return {"requests": self.requests, "clients": self.clients,
-                "seed": self.seed, "zipf": {"lmin": self.lmin,
-                                            "lmax": self.lmax,
-                                            "alpha": self.alpha},
-                "max_new": self.max_new,
-                "prefill": {"mode": self.prefill_mode,
-                            "chunk": self.prefill_chunk},
-                "model": {"vocab": self.vocab, "embed": self.embed,
-                          "heads": self.heads, "ffn": self.ffn,
-                          "layers": self.layers}}
+        d = {"requests": self.requests, "clients": self.clients,
+             "seed": self.seed, "workload": self.workload,
+             "zipf": {"lmin": self.lmin, "lmax": self.lmax,
+                      "alpha": self.alpha},
+             "max_new": self.max_new,
+             "prefill": {"mode": self.prefill_mode,
+                         "chunk": self.prefill_chunk},
+             "prefix_cache": self.prefix_cache,
+             "model": {"vocab": self.vocab, "embed": self.embed,
+                       "heads": self.heads, "ffn": self.ffn,
+                       "layers": self.layers}}
+        if self.workload == "shared-prefix":
+            d["shared_prefix"] = {"templates": self.templates,
+                                  "template_len": self.template_len}
+        if self.draft:
+            d["speculative"] = {"spec_len": self.spec_len,
+                                "draft": ("identical-weights"
+                                          if self.draft == "identical"
+                                          else "int8-self")}
+        return d
 
 
 def zipf_lengths(n: int, *, seed: int, lmin: int, lmax: int,
@@ -120,8 +167,30 @@ def zipf_lengths(n: int, *, seed: int, lmin: int, lmax: int,
     return rng.choices(lengths, weights=weights, k=n)
 
 
+def shared_prefix_prompts(cfg: ScoreboardConfig) -> List[List[int]]:
+    """The prefix-cache stress workload: a Zipf-weighted draw over a
+    SMALL pool of long shared templates, each request appending a unique
+    random tail — the few-system-prompts/many-continuations shape real
+    serving traffic has. Deterministic under the config seed; tail
+    lengths reuse the Zipf length machinery over [lmin, lmax]."""
+    rng = random.Random(cfg.seed + 2)
+    pool = [[rng.randint(1, cfg.vocab) for _ in range(cfg.template_len)]
+            for _ in range(max(1, cfg.templates))]
+    ranks = list(range(len(pool)))
+    weights = [1.0 / (r + 1) ** cfg.alpha for r in ranks]
+    tails = zipf_lengths(cfg.requests, seed=cfg.seed + 3, lmin=cfg.lmin,
+                         lmax=cfg.lmax, alpha=cfg.alpha)
+    out = []
+    for ln in tails:
+        tpl = pool[rng.choices(ranks, weights=weights)[0]]
+        out.append(tpl + [rng.randint(1, cfg.vocab) for _ in range(ln)])
+    return out
+
+
 def make_prompts(cfg: ScoreboardConfig) -> List[List[int]]:
     """The seeded workload: one 1-based id list per request."""
+    if cfg.workload == "shared-prefix":
+        return shared_prefix_prompts(cfg)
     rng = random.Random(cfg.seed + 1)
     out = []
     for ln in zipf_lengths(cfg.requests, seed=cfg.seed, lmin=cfg.lmin,
@@ -166,12 +235,26 @@ def _drive_one(cfg: ScoreboardConfig, slots: int) -> dict:
     # high-water mark would be reported for every later row too
     peak_before = sample_device_memory(registry)
     model = _build_model(cfg)
+    # "identical" draft (same seeded build) is the acceptance-rate
+    # CEILING for the speculative machinery; "int8" is self-speculation
+    # against a quantized twin — the acceptance a real deployment
+    # pattern measures. Either way the row's headline is the verify-
+    # dispatch economics at the measured acceptance, not a wall-clock
+    # win: at toy scale no draft is cheaper than the target.
+    draft = None
+    if cfg.draft == "identical":
+        draft = _build_model(cfg)
+    elif cfg.draft == "int8":
+        from bigdl_tpu.nn.quantized import quantize_model
+        draft = quantize_model(_build_model(cfg))
     server = ContinuousLMServer(model, slots=slots, max_len=cfg.max_len,
                                 decode_block=cfg.decode_block, greedy=True,
                                 max_new_tokens=cfg.max_new,
                                 seed=cfg.seed, registry=registry,
                                 prefill_mode=cfg.prefill_mode,
-                                prefill_chunk=cfg.prefill_chunk)
+                                prefill_chunk=cfg.prefill_chunk,
+                                prefix_cache=cfg.prefix_cache,
+                                draft=draft, spec_len=cfg.spec_len)
     prompts = make_prompts(cfg)
     errors: List[str] = []
     lock = threading.Lock()
@@ -218,6 +301,20 @@ def _drive_one(cfg: ScoreboardConfig, slots: int) -> dict:
             and peak_mem <= peak_before:
         peak_mem = None     # watermark set by an EARLIER row: unknown here
     tokens = tm.serving_tokens_total.value
+    # round-9 serving modes: hit rate counts ADMISSIONS (one verdict per
+    # prefill), accept rate counts DRAFT tokens (the target's bonus token
+    # is excluded on both sides of the ratio)
+    p_hits = tm.prefix_cache_hits.value
+    p_miss = tm.prefix_cache_misses.value
+    hit_rate = (round(p_hits / (p_hits + p_miss), 3)
+                if server.prefix_cache_enabled and (p_hits + p_miss)
+                else None)
+    proposed = tm.spec_proposed_tokens_total.value
+    accepted = tm.spec_accepted_tokens_total.value
+    accept_rate = (round(accepted / proposed, 3)
+                   if cfg.draft and proposed else None)
+    ttft_hit = tm.serving_ttft_hit_seconds.labels().snapshot()
+    ttft_miss = tm.serving_ttft_miss_seconds.labels().snapshot()
     return {
         "slots": slots,
         "prefill_mode": cfg.prefill_mode,
@@ -227,8 +324,12 @@ def _drive_one(cfg: ScoreboardConfig, slots: int) -> dict:
         "tok_s": round(tokens / wall, 2) if wall > 0 else 0.0,
         "ttft_p50_s": quantile_from_snapshot(ttft, 0.5),
         "ttft_p95_s": quantile_from_snapshot(ttft, 0.95),
+        "ttft_hit_p50_s": quantile_from_snapshot(ttft_hit, 0.5),
+        "ttft_miss_p50_s": quantile_from_snapshot(ttft_miss, 0.5),
         "token_latency_s": (round(tok["sum"] / tok["count"], 6)
                             if tok["count"] else None),
+        "prefix_hit_rate": hit_rate,
+        "spec_accept_rate": accept_rate,
         "compiles": int(compiles),
         "compile_seconds": round(compile_seconds, 3),
         "cache_evictions": int(evictions),
@@ -315,11 +416,16 @@ def scrape(url: str, timeout: float = 5.0) -> dict:
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         text = resp.read().decode("utf-8", errors="replace")
     values, hists = _parse_prometheus(text)
-    ttft = hists.get("bigdl_serving_ttft_seconds",
-                     {"buckets": [], "count": 0, "sum": 0.0, "inf": 0})
-    tok = hists.get("bigdl_serving_token_latency_seconds",
-                    {"buckets": [], "count": 0, "sum": 0.0, "inf": 0})
+    empty = {"buckets": [], "count": 0, "sum": 0.0, "inf": 0}
+    ttft = hists.get("bigdl_serving_ttft_seconds", empty)
+    tok = hists.get("bigdl_serving_token_latency_seconds", empty)
+    ttft_hit = hists.get("bigdl_serving_ttft_hit_seconds", empty)
+    ttft_miss = hists.get("bigdl_serving_ttft_miss_seconds", empty)
     peak = values.get("bigdl_device_memory_peak_bytes")
+    p_hits = values.get("bigdl_prefix_cache_hits", 0.0)
+    p_miss = values.get("bigdl_prefix_cache_misses", 0.0)
+    proposed = values.get("bigdl_spec_proposed_tokens_total", 0.0)
+    accepted = values.get("bigdl_spec_accepted_tokens_total", 0.0)
     row = {
         "slots": int(values.get("bigdl_serving_slots_total", 0)),
         "prefill_mode": None,       # not exposed by /metrics; unknown
@@ -331,8 +437,14 @@ def scrape(url: str, timeout: float = 5.0) -> dict:
         "tokens": int(values.get("bigdl_serving_tokens_total", 0)),
         "ttft_p50_s": quantile_from_snapshot(ttft, 0.5),
         "ttft_p95_s": quantile_from_snapshot(ttft, 0.95),
+        "ttft_hit_p50_s": quantile_from_snapshot(ttft_hit, 0.5),
+        "ttft_miss_p50_s": quantile_from_snapshot(ttft_miss, 0.5),
         "token_latency_s": (round(tok["sum"] / tok["count"], 6)
                             if tok.get("count") else None),
+        "prefix_hit_rate": (round(p_hits / (p_hits + p_miss), 3)
+                            if (p_hits + p_miss) else None),
+        "spec_accept_rate": (round(accepted / proposed, 3)
+                             if proposed else None),
         "compiles": int(values.get("bigdl_compiles_total", 0)),
         "compile_seconds": round(
             hists.get("bigdl_compile_seconds", {}).get("sum", 0.0), 3),
@@ -358,36 +470,67 @@ def _fmt_mem(v: Optional[float]) -> str:
     return "—" if not v else f"{v / (1 << 20):.1f}"
 
 
+def _fmt_rate(v: Optional[float]) -> str:
+    return "—" if v is None else f"{v:.2f}"
+
+
 def render_markdown(artifact: dict) -> str:
-    """The PERF.md serving-scoreboard table."""
+    """The PERF.md serving-scoreboard table. The round-9 serving-mode
+    columns (prefix hit rate + hit/miss TTFT split, speculative accept
+    rate) render only when some row carries them, so pre-round-9
+    artifacts keep their exact historical table shape."""
+    rows = artifact.get("rows", [])
+    with_prefix = any(r.get("prefix_hit_rate") is not None or
+                      r.get("ttft_hit_p50_s") is not None for r in rows)
+    with_spec = any(r.get("spec_accept_rate") is not None for r in rows)
     w = artifact.get("workload", {})
     z = w.get("zipf", {})
-    lines = [
-        "| slots | prefill | tok/s | TTFT p50 (ms) | TTFT p95 (ms) | "
-        "per-token (ms) | compiles | compile s | evictions | "
-        "peak mem (MiB) |",
-        "|------:|:--------|------:|--------------:|--------------:|"
-        "---------------:|---------:|----------:|----------:|"
-        "---------------:|",
-    ]
-    for r in artifact.get("rows", []):
+    head = ("| slots | prefill | tok/s | TTFT p50 (ms) | TTFT p95 (ms) |"
+            " per-token (ms) |")
+    rule = ("|------:|:--------|------:|--------------:|--------------:|"
+            "---------------:|")
+    if with_prefix:
+        head += " hit rate | TTFT hit p50 (ms) | TTFT miss p50 (ms) |"
+        rule += "---------:|------------------:|-------------------:|"
+    if with_spec:
+        head += " accept |"
+        rule += "-------:|"
+    head += (" compiles | compile s | evictions | peak mem (MiB) |")
+    rule += ("---------:|----------:|----------:|---------------:|")
+    lines = [head, rule]
+    for r in rows:
         tok_s = r.get("tok_s")
-        lines.append(
-            f"| {r.get('slots', '?')} "
-            f"| {r.get('prefill_mode') or '—'} "
-            f"| {tok_s if tok_s is not None else '—'} "
-            f"| {_fmt_ms(r.get('ttft_p50_s'))} "
-            f"| {_fmt_ms(r.get('ttft_p95_s'))} "
-            f"| {_fmt_ms(r.get('token_latency_s'))} "
-            f"| {r.get('compiles', '—')} "
-            f"| {r.get('compile_seconds', '—')} "
-            f"| {r.get('cache_evictions', '—')} "
-            f"| {_fmt_mem(r.get('peak_memory_bytes'))} |")
+        cells = [
+            f"{r.get('slots', '?')}",
+            f"{r.get('prefill_mode') or '—'}",
+            f"{tok_s if tok_s is not None else '—'}",
+            _fmt_ms(r.get("ttft_p50_s")),
+            _fmt_ms(r.get("ttft_p95_s")),
+            _fmt_ms(r.get("token_latency_s")),
+        ]
+        if with_prefix:
+            cells += [_fmt_rate(r.get("prefix_hit_rate")),
+                      _fmt_ms(r.get("ttft_hit_p50_s")),
+                      _fmt_ms(r.get("ttft_miss_p50_s"))]
+        if with_spec:
+            cells.append(_fmt_rate(r.get("spec_accept_rate")))
+        cells += [f"{r.get('compiles', '—')}",
+                  f"{r.get('compile_seconds', '—')}",
+                  f"{r.get('cache_evictions', '—')}",
+                  _fmt_mem(r.get("peak_memory_bytes"))]
+        lines.append("| " + " | ".join(cells) + " |")
     meta = (f"backend={artifact.get('backend', '?')}, "
             f"requests={w.get('requests', '?')}/slot-count, "
             f"Zipf({z.get('alpha', '?')}) prompt lengths "
             f"[{z.get('lmin', '?')}, {z.get('lmax', '?')}], "
             f"seed={w.get('seed', '?')}")
+    if w.get("workload") == "shared-prefix":
+        sp = w.get("shared_prefix", {})
+        meta += (f", shared-prefix {sp.get('templates', '?')} templates × "
+                 f"{sp.get('template_len', '?')} tokens")
+    if w.get("speculative"):
+        meta += (f", speculative k={w['speculative'].get('spec_len', '?')}"
+                 f" ({w['speculative'].get('draft', '?')} draft)")
     lines.append("")
     lines.append(f"<small>{meta}</small>")
     return "\n".join(lines)

@@ -1,0 +1,372 @@
+"""Cross-request KV prefix cache (round 9 tentpole, ROADMAP #2).
+
+Correctness bar, in order of importance: a prefix-cache-HIT admission
+must emit EXACTLY the tokens a cold chunked prefill emits (greedy,
+bit-identical) at every edge length — hit ending mid-chunk, hit covering
+the full chunked portion, no hit at all — because a wrong-but-plausible
+KV resume would silently corrupt every continuation sharing that prefix.
+Then: the trie's own semantics (rolling-hash descent identity,
+chunk-boundary splits, LRU bound with counted one-at-a-time eviction),
+concurrency under admit-vs-evict races, and the serialization regression
+(a served model must deepcopy/pickle without dragging cached KV or the
+trie's thread lock along).
+"""
+
+import copy
+import pickle
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.models import transformer
+from bigdl_tpu.models.generation import generate
+from bigdl_tpu.models.prefix_cache import (PrefixCache, prefix_cache_for,
+                                           rolling_hash)
+from bigdl_tpu.models.serving import ContinuousLMServer
+from bigdl_tpu.telemetry import MetricsRegistry, instruments
+from bigdl_tpu.utils.rng import manual_seed
+
+VOCAB = 24
+
+
+def _mk_model(seed=4):
+    manual_seed(seed)
+    return transformer.build_lm(VOCAB, 16, 2, 32, num_layers=2, max_len=64,
+                                rope=True, activation="swiglu", norm="rms",
+                                tie_embeddings=True)
+
+
+def _ref_continuation(ref_model, ids, max_new):
+    out = np.asarray(generate(ref_model, jnp.asarray(
+        np.asarray(ids, np.float32)[None]), max_new, greedy=True))
+    return out[0, len(ids):].astype(int).tolist()
+
+
+def _state(tag, kb=1):
+    """A fake state partition: identifiable arrays of ``kb`` KiB."""
+    return [jnp.full((kb * 256,), float(tag), jnp.float32)]
+
+
+class TestRollingHash:
+    def test_extension_identity(self):
+        """The trie-descent identity: hashing b on top of hash(a) equals
+        hashing a+b in one pass."""
+        a, b = [3, 7, 2, 9], [11, 4, 6]
+        assert rolling_hash(b, rolling_hash(a)) == rolling_hash(a + b)
+        assert rolling_hash([]) == 0
+        # 1-based ids and the +1 offset: a leading 0 still perturbs
+        assert rolling_hash([0, 1]) != rolling_hash([1])
+
+    def test_order_and_length_sensitivity(self):
+        assert rolling_hash([1, 2]) != rolling_hash([2, 1])
+        assert rolling_hash([1]) != rolling_hash([1, 1])
+
+
+class TestPrefixCacheUnit:
+    def test_chunk_boundary_splits(self):
+        """Only whole-chunk prefixes store; lookups split any prompt at
+        its deepest cached chunk boundary."""
+        pc = PrefixCache(chunk=4, max_bytes=1 << 20)
+        toks = list(range(1, 13))               # 12 tokens = 3 chunks
+        pc.put(toks[:4], _state(1))
+        pc.put(toks[:8], _state(2))
+        assert pc.boundaries() == [4, 8]
+        # mid-chunk query depth: deepest boundary <= query, not beyond
+        depth, state = pc.match(toks[:6])
+        assert depth == 4 and float(state[0][0]) == 1.0
+        depth, state = pc.match(toks[:11])      # 11 aligns down to 8
+        assert depth == 8 and float(state[0][0]) == 2.0
+        # full 12 tokens: depth-12 never stored, deepest is still 8
+        assert pc.match(toks)[0] == 8
+        # diverging tail at the second chunk falls back to the first
+        assert pc.match(toks[:4] + [99, 98, 97, 96])[0] == 4
+        # shorter than one chunk can never hit
+        assert pc.match(toks[:3]) == (0, None)
+        assert (pc.hits, pc.misses) == (4, 1)
+
+    def test_put_rejects_ragged_prefix(self):
+        pc = PrefixCache(chunk=4, max_bytes=1 << 20)
+        with pytest.raises(ValueError, match="whole number of chunks"):
+            pc.put([1, 2, 3], _state(1))
+        with pytest.raises(ValueError, match="whole number of chunks"):
+            pc.put([], _state(1))
+
+    def test_match_returns_owned_copy(self):
+        """The returned state is donate-safe: mutating it (or donating
+        it to a jit) must not corrupt the stored snapshot."""
+        pc = PrefixCache(chunk=2, max_bytes=1 << 20)
+        pc.put([5, 6], _state(7))
+        _, got = pc.match([5, 6])
+        got[0] = got[0] * 0          # simulate the consumer clobbering it
+        _, again = pc.match([5, 6])
+        assert float(again[0][0]) == 7.0
+
+    def test_refresh_is_copy_free_and_lru(self):
+        pc = PrefixCache(chunk=2, max_bytes=3 * _state(0)[0].nbytes)
+        pc.put([1, 2], _state(1))
+        pc.put([3, 4], _state(2))
+        pc.put([5, 6], _state(3))
+        pc.put([1, 2], _state(99))   # known prefix: refresh, NOT replace
+        _, s = pc.match([1, 2])
+        assert float(s[0][0]) == 1.0            # original snapshot kept
+        # the refresh moved [1,2] to most-recent: overflow evicts [3,4]
+        pc.put([7, 8], _state(4))
+        assert pc.match([3, 4]) == (0, None)
+        assert pc.match([1, 2])[0] == 2
+
+    def test_bound_and_counted_eviction(self):
+        one = _state(0)[0].nbytes
+        pc = PrefixCache(chunk=2, max_bytes=2 * one)
+        pc.put([1, 2], _state(1))
+        pc.put([3, 4], _state(2))
+        assert (len(pc), pc.evictions) == (2, 0)
+        # one over budget evicts exactly the ONE oldest entry
+        assert pc.put([5, 6], _state(3)) == 1
+        assert (len(pc), pc.evictions) == (2, 1)
+        assert pc.match([1, 2]) == (0, None)    # the evictee
+        assert pc.match([5, 6])[0] == 2
+        assert pc.nbytes == 2 * one
+        # a multi-entry displacement counts every eviction
+        assert pc.put([7, 8], _state(4, kb=2)) == 2
+        assert pc.evictions == 3 and len(pc) == 1
+
+    def test_oversize_snapshot_refused_not_thrashed(self):
+        pc = PrefixCache(chunk=2, max_bytes=_state(0)[0].nbytes)
+        pc.put([1, 2], _state(1))
+        assert pc.put([3, 4], _state(2, kb=4)) == 0     # refused outright
+        assert pc.match([3, 4]) == (0, None)
+        assert pc.match([1, 2])[0] == 2         # resident entry untouched
+        assert pc.evictions == 0
+
+    def test_newest_entry_survives_even_over_budget(self):
+        """The len>1 eviction guard: a cache must always hold its newest
+        admissible entry, not evict itself empty."""
+        one = _state(0, kb=1)[0].nbytes
+        pc = PrefixCache(chunk=2, max_bytes=int(one * 1.5))
+        pc.put([1, 2], _state(1))
+        pc.put([3, 4], _state(2))               # over budget together
+        assert len(pc) == 1
+        assert pc.match([3, 4])[0] == 2
+
+    def test_clear(self):
+        pc = PrefixCache(chunk=2, max_bytes=1 << 20)
+        pc.put([1, 2], _state(1))
+        pc.clear()
+        assert (len(pc), pc.nbytes) == (0, 0)
+        assert pc.match([1, 2]) == (0, None)
+
+    def test_concurrent_admit_vs_evict(self):
+        """Hammer put/match from threads against a bound tight enough to
+        force constant eviction: no exception, and the bound + byte
+        accounting hold at every quiescent point (JG015-017: all
+        mutation under the cache lock, no device sync held)."""
+        one = _state(0)[0].nbytes
+        pc = PrefixCache(chunk=2, max_bytes=4 * one)
+        errors = []
+
+        def worker(base):
+            try:
+                for i in range(40):
+                    t = [base * 100 + i, i + 1]
+                    pc.put(t, _state(base))
+                    pc.match(t)
+                    pc.match([base * 100 + (i + 7) % 40, 1])
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(b,))
+                   for b in range(1, 5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert pc.nbytes <= 4 * one and len(pc) <= 4
+        assert pc.nbytes == sum(n.state[0].nbytes
+                                for n in pc._entries.values())
+        assert pc.evictions > 0
+
+
+class TestPrefixCacheForModel:
+    def test_attaches_per_config_and_bounded(self):
+        model = _mk_model()
+        a = prefix_cache_for(model, chunk=4, cache_len=16,
+                             max_bytes=1 << 20)
+        again = prefix_cache_for(model, chunk=4, cache_len=16,
+                                 max_bytes=1 << 10)
+        assert again is a and a.max_bytes == 1 << 10   # latest budget wins
+        b = prefix_cache_for(model, chunk=8, cache_len=16,
+                             max_bytes=1 << 20)
+        assert b is not a
+        for i in range(8):                      # config churn stays bounded
+            prefix_cache_for(model, chunk=4, cache_len=32 + i,
+                             max_bytes=1 << 20)
+        assert len(model.__dict__["_prefix_trie"]) <= 4
+
+
+class TestPrefixServingBitExact:
+    """The headline guarantee: hit admissions reproduce cold outputs
+    bit-for-bit at every hit geometry."""
+
+    C = 4
+
+    def _serve_all(self, prompts, *, prefix_cache, registry=None,
+                   max_new=6):
+        srv = ContinuousLMServer(_mk_model(), slots=2, max_len=48,
+                                 greedy=True, decode_block=4,
+                                 prefill_chunk=self.C,
+                                 prefix_cache=prefix_cache,
+                                 registry=registry or MetricsRegistry())
+        try:
+            return [srv.submit(p, max_new_tokens=max_new, timeout=120)
+                    for p in prompts], srv
+        finally:
+            srv.close()
+
+    def test_hit_geometries_match_cold_and_generate(self):
+        c = self.C
+        shared = [(3 * i) % VOCAB + 1 for i in range(3 * c)]   # 3 chunks
+        prompts = [
+            shared + [7, 9],            # seeds the trie (miss)
+            shared + [11, 5],           # hit ends mid-chunk of the tail
+            shared[:2 * c + 1],         # hit at 2c, one-token tail
+            shared,                     # hit == every full chunk (n=3c,
+                                        # chunked portion 3c-1 -> depth 2c)
+            shared[:c - 1],             # shorter than one chunk: empty hit
+            list(reversed(shared)) + [2],   # no shared prefix at all
+            shared + [7, 9],            # exact repeat of the seed
+        ]
+        reg = MetricsRegistry()
+        warm, srv = self._serve_all(prompts, prefix_cache=True,
+                                    registry=reg)
+        cold, _ = self._serve_all(prompts, prefix_cache=False)
+        assert warm == cold
+        ref = _mk_model()
+        assert warm == [_ref_continuation(ref, p, 6) for p in prompts]
+        pc = srv._pipeline.prefix
+        assert pc.hits >= 4 and pc.misses >= 1
+        tm = instruments(reg)
+        assert tm.prefix_cache_hits.value == pc.hits
+        assert tm.prefix_cache_misses.value == pc.misses
+        assert tm.prefix_cache_bytes.value == pc.nbytes > 0
+        # every admission lands in exactly one of the hit/miss TTFT
+        # histograms
+        n_hit = tm.serving_ttft_hit_seconds.labels().snapshot()["count"]
+        n_miss = tm.serving_ttft_miss_seconds.labels().snapshot()["count"]
+        assert n_hit + n_miss == len(prompts)
+        assert n_hit == pc.hits and n_miss == pc.misses
+
+    def test_hits_compile_nothing_new(self):
+        """A hit admission reuses the same two chunked-prefill programs —
+        the flight recorder must see ZERO builds after warmup."""
+        reg = MetricsRegistry()
+        shared = [(5 * i) % VOCAB + 1 for i in range(2 * self.C)]
+        srv = ContinuousLMServer(_mk_model(), slots=2, max_len=48,
+                                 greedy=True, prefill_chunk=self.C,
+                                 registry=reg)
+        try:
+            srv.submit(shared + [3], max_new_tokens=2, timeout=120)
+            tm = instruments(reg)
+            before = tm.compiles_total.labels(site="serving.prefill").value
+            srv.submit(shared + [9], max_new_tokens=2, timeout=120)
+            srv.submit(shared + [9, 9, 9], max_new_tokens=2, timeout=120)
+            assert tm.compiles_total.labels(
+                site="serving.prefill").value == before
+        finally:
+            srv.close()
+
+    def test_eviction_metrics_mirrored(self):
+        """A budget small enough to force trie eviction surfaces in the
+        registry counter, and the serving path keeps working."""
+        reg = MetricsRegistry()
+        srv = ContinuousLMServer(_mk_model(), slots=2, max_len=48,
+                                 greedy=True, prefill_chunk=self.C,
+                                 prefix_cache_mb=0.05, registry=reg)
+        try:
+            for s in range(6):      # disjoint 2-chunk prefixes
+                ids = [(s * 7 + i) % VOCAB + 1 for i in range(2 * self.C)]
+                srv.submit(ids + [s + 1], max_new_tokens=2, timeout=120)
+            pc = srv._pipeline.prefix
+            assert pc.evictions > 0
+            assert instruments(reg).prefix_cache_evictions.value \
+                == pc.evictions
+            assert pc.nbytes <= pc.max_bytes
+        finally:
+            srv.close()
+
+    def test_disabled_modes(self):
+        """prefix_cache=False and bucketed mode build no trie at all."""
+        srv = ContinuousLMServer(_mk_model(), slots=1, max_len=32,
+                                 greedy=True, prefix_cache=False)
+        try:
+            assert srv._pipeline.prefix is None
+            assert not srv.prefix_cache_enabled
+        finally:
+            srv.close()
+        srv = ContinuousLMServer(_mk_model(), slots=1, max_len=32,
+                                 greedy=True, prefill_mode="bucketed")
+        try:
+            assert srv._pipeline.prefix is None
+            assert not srv.prefix_cache_enabled
+        finally:
+            srv.close()
+
+
+class TestServedModelSerialization:
+    """Regression for the ``__getstate__`` cache audit: every
+    per-instance attachment cache — compiled programs AND the prefix
+    trie (which holds an unpicklable thread lock plus cached KV) — must
+    drop on deepcopy/pickle, and the copy must still serve."""
+
+    def test_served_model_deepcopy_and_pickle(self):
+        model = _mk_model()
+        srv = ContinuousLMServer(model, slots=2, max_len=48, greedy=True,
+                                 prefill_chunk=4,
+                                 registry=MetricsRegistry())
+        try:
+            ids = [(3 * i) % VOCAB + 1 for i in range(9)]
+            want = srv.submit(ids, max_new_tokens=4, timeout=120)
+        finally:
+            srv.close()
+        assert model.__dict__["_prefix_trie"]      # trie is populated
+        clone = copy.deepcopy(model)
+        loaded = pickle.loads(pickle.dumps(model))
+        for m in (clone, loaded):
+            for key in type(model)._EPHEMERAL_CACHES:
+                assert key not in m.__dict__, key
+        # the round-tripped model still serves, and identically
+        srv2 = ContinuousLMServer(loaded, slots=2, max_len=48,
+                                  greedy=True, prefill_chunk=4,
+                                  registry=MetricsRegistry())
+        try:
+            assert srv2.submit(ids, max_new_tokens=4,
+                               timeout=120) == want
+        finally:
+            srv2.close()
+
+    def test_ephemeral_cache_tuple_covers_every_attach_site(self):
+        """The audit list IS the contract: every ``model.__dict__``
+        attachment cache in the codebase must appear in
+        ``Module._EPHEMERAL_CACHES`` (a new cache added without updating
+        the tuple fails here, not in a production pickle)."""
+        import os
+        import re
+        from bigdl_tpu import nn
+        root = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bigdl_tpu")
+        attached = set()
+        pat = re.compile(
+            r"__dict__(?:\.setdefault\(|\[)\s*[\"'](_[a-z_]+)[\"']")
+        for dirpath, _dirs, files in os.walk(root):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                with open(os.path.join(dirpath, fn)) as f:
+                    attached.update(pat.findall(f.read()))
+        attached -= {"_modules"}        # structural, must serialize
+        missing = attached - set(nn.Module._EPHEMERAL_CACHES)
+        assert not missing, (
+            f"caches attached via model.__dict__ but not popped by "
+            f"Module.__getstate__: {sorted(missing)}")

@@ -450,3 +450,108 @@ class TestSlotStateLock:
         assert len(srv._free) == len(set(srv._free))   # no double-free
         assert set(srv._free) <= set(range(3))
         assert not srv._active
+
+
+def _adversarial_draft():
+    """A draft whose greedy proposals DISAGREE with the seed-4 target
+    about half the time when conditioned on the target's accepted
+    context (seed 2 + gelu, measured 33/64 disagreeing positions), so
+    the verify path's rejection + per-row KV rollback actually runs.
+    Solo traces are a useless diagnostic here — tiny models all echo
+    the prompt's dominant token — only conditioned proposals diverge."""
+    manual_seed(2)
+    return transformer.build_lm(VOCAB, 16, 2, 32, num_layers=2,
+                                max_len=64, rope=True,
+                                activation="gelu", norm="rms",
+                                tie_embeddings=True)
+
+
+class TestSpeculativeDecode:
+    """Round-9 tentpole (b): draft-assisted decode in the slot engine.
+
+    Correctness bar mirrors the chunked-prefill one: greedy output with
+    ANY draft — agreeing or adversarial — must be bit-identical to the
+    non-speculative server and to plain ``generate``, because the target
+    verify + rollback is exact, never approximate. Speed is allowed to
+    vary with acceptance; tokens are not."""
+
+    def _spec_server(self, draft, registry=None, spec_len=3, slots=2):
+        return ContinuousLMServer(_mk_model(), slots=slots, max_len=48,
+                                  greedy=True, decode_block=4,
+                                  prefill_chunk=4, draft=draft,
+                                  spec_len=spec_len, registry=registry)
+
+    def test_identical_draft_bit_exact_full_acceptance(self):
+        from bigdl_tpu.telemetry import MetricsRegistry, instruments
+        registry = MetricsRegistry()
+        ref = _mk_model()
+        srv = self._spec_server(_mk_model(), registry=registry)
+        try:
+            for ids, mx in ([3, 7, 2], 8), ([9, 1, 4, 4, 2, 6], 6):
+                assert srv.submit(ids, max_new_tokens=mx, timeout=120) \
+                    == _ref_continuation(ref, ids, mx)
+        finally:
+            srv.close()
+        tm = instruments(registry)
+        proposed = tm.spec_proposed_tokens_total.value
+        accepted = tm.spec_accepted_tokens_total.value
+        # an identical-weights draft is the acceptance ceiling: every
+        # proposal verifies
+        assert proposed > 0 and accepted == proposed
+
+    def test_adversarial_draft_bit_exact_with_rejections(self):
+        """The draft disagrees mid-round, so acceptance < 1 and the
+        per-row rollback path runs — output must STILL match exactly."""
+        from bigdl_tpu.telemetry import MetricsRegistry, instruments
+        registry = MetricsRegistry()
+        ref = _mk_model()
+        srv = self._spec_server(_adversarial_draft(),
+                                registry=registry)
+        try:
+            for ids, mx in ([3, 7, 2], 8), ([5, 5, 1, 8], 7), ([2], 9):
+                assert srv.submit(ids, max_new_tokens=mx, timeout=120) \
+                    == _ref_continuation(ref, ids, mx)
+        finally:
+            srv.close()
+        tm = instruments(registry)
+        proposed = tm.spec_proposed_tokens_total.value
+        accepted = tm.spec_accepted_tokens_total.value
+        assert 0 <= accepted < proposed
+
+    def test_mixed_inflight_each_matches_solo(self):
+        """Per-row rollback under load: rows at different positions with
+        different acceptance in the SAME verify dispatch must not bleed
+        into each other."""
+        ref = _mk_model()
+        srv = self._spec_server(_adversarial_draft(), slots=3)
+        prompts = [[3, 7], [9, 1, 4, 4, 2, 6, 8], [5] * 4, [2, 11],
+                   [7, 7, 7], [1, 2, 3, 4, 5]]
+        results = [None] * len(prompts)
+
+        def client(i):
+            results[i] = srv.submit(prompts[i], max_new_tokens=6,
+                                    timeout=120)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(prompts))]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        finally:
+            srv.close()
+        for i, ids in enumerate(prompts):
+            assert results[i] == _ref_continuation(ref, ids, 6), i
+
+    def test_rejects_bad_spec_config(self):
+        model = _mk_model()
+        with pytest.raises(ValueError, match="draft"):
+            ContinuousLMServer(model, slots=1, max_len=16, greedy=True,
+                               draft=model)
+        with pytest.raises(ValueError, match="greedy-only"):
+            ContinuousLMServer(model, slots=1, max_len=16, greedy=False,
+                               draft=_mk_model())
+        with pytest.raises(ValueError, match="spec_len"):
+            ContinuousLMServer(model, slots=1, max_len=16, greedy=True,
+                               draft=_mk_model(), spec_len=0)
