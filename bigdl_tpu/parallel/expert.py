@@ -6,12 +6,17 @@ parallelism: ABSENT". ``MoE`` is its distributed descendant, built the
 GShard/Switch way for TPU:
 
 - top-k softmax gating with capacity limiting;
-- ragged scatter/gather dispatch (default): tokens scatter-add into the
-  (expert, capacity, d) buffers and gather back by (expert, slot) index —
-  static shapes, O(E·C·D) memory instead of the dense (T, E, C)
-  dispatch/combine masks, which dominate memory at real token counts;
-  ``dispatch="einsum"`` keeps the dense GShard-paper formulation for
-  comparison/debug;
+- sort-based ragged dispatch (default, round 10): ONE stable argsort of
+  the round-major token→expert picks replaces the k× one-hot + cumsum +
+  scatter-add position bookkeeping — capacity slots fall out of segment
+  offsets (rank within the expert's sorted run), tokens GATHER into the
+  (expert, capacity, d) buffers, and the combine reads back through the
+  same indices. Static shapes, O(E·C·D) memory, and no (T, E)-wide
+  cumsum chains or scatter traffic on the hot path;
+- ``dispatch="scatter"`` keeps the round-5 scatter-add formulation and
+  ``dispatch="einsum"`` the dense GShard-paper (T, E, C) masks, both for
+  A/B comparison/debug — all three are bit-equivalent (same routing,
+  same drop semantics, same combine op order);
 - expert FFN weights STACKED on a leading expert axis; under expert
   parallelism those leaves are sharded ``P('expert', ...)`` and GSPMD turns
   the dispatch einsums into all_to_alls over the mesh ``expert`` axis —
@@ -69,11 +74,11 @@ class MoE(Module):
     def __init__(self, input_size: int, hidden_size: int, n_experts: int,
                  k: int = 2, capacity_factor: float = 1.25,
                  activation: str = "gelu", aux_loss_weight: float = 1e-2,
-                 dispatch: str = "scatter"):
+                 dispatch: str = "sort"):
         super().__init__()
-        if dispatch not in ("scatter", "einsum"):
-            raise ValueError(f"dispatch must be 'scatter' or 'einsum', "
-                             f"got {dispatch!r}")
+        if dispatch not in ("sort", "scatter", "einsum"):
+            raise ValueError(f"dispatch must be 'sort', 'scatter' or "
+                             f"'einsum', got {dispatch!r}")
         self.input_size = input_size
         self.hidden_size = hidden_size
         self.n_experts = n_experts
@@ -102,30 +107,68 @@ class MoE(Module):
         capacity = max(1, int(np.ceil(t / e * self.capacity_factor * k)))
         capacity = min(capacity, t)
 
+        from bigdl_tpu.telemetry import get_registry, instruments
+        # trace-time count (like bigdl_int8_fallbacks_total): which
+        # dispatch formulation each compiled MoE forward uses
+        instruments(get_registry()).moe_dispatch_total.labels(
+            path=self.dispatch).inc()
+
         logits = x @ self.gate_weight                      # (T, E)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
-        # Iterative top-k routing metadata: O(T·E) position bookkeeping
-        # (running per-expert counts), never a (T, E, C) tensor. Slots
-        # already used per expert accumulate across the k picks.
+        # Iterative top-k routing: the pick/gate loop is shared by all
+        # dispatch paths (identical argmax tie-breaking). Slot/keep
+        # bookkeeping differs: sort derives it from ONE stable argsort
+        # below; scatter/einsum keep the O(T·E) running-count cumsums.
+        use_sort = self.dispatch == "sort"
         masked = probs
         fill = jnp.zeros((e,), jnp.int32)
         topk_mask = jnp.zeros_like(probs)
-        picks = []  # (expert (T,), slot (T,), gate weight w/ drops zeroed)
+        picks = []  # (expert (T,), slot (T,), keep, gate weight w/ drops 0)
         for _ in range(k):
             pick = jnp.argmax(masked, axis=-1)             # (T,)
             onehot = jax.nn.one_hot(pick, e, dtype=jnp.float32)
             topk_mask = topk_mask + onehot
-            # Position of each token in its expert's capacity buffer:
-            # running count of earlier tokens routed to the same expert.
-            pos = (jnp.cumsum(onehot, axis=0) - onehot) + fill[None, :]
-            pos_t = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # (T,)
-            keep = pos_t < capacity
-            w = jnp.sum(probs * onehot, axis=-1) * keep    # (T,)
-            picks.append((pick, jnp.where(keep, pos_t, 0), keep, w))
-            fill = fill + jnp.sum(onehot * keep[:, None],
-                                  axis=0).astype(jnp.int32)
+            gate = jnp.sum(probs * onehot, axis=-1)        # (T,)
+            if use_sort:
+                picks.append((pick, None, None, gate))
+            else:
+                # Position of each token in its expert's capacity buffer:
+                # running count of earlier tokens routed to the same
+                # expert; slots used accumulate across the k picks.
+                pos = (jnp.cumsum(onehot, axis=0) - onehot) + fill[None, :]
+                pos_t = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
+                keep = pos_t < capacity
+                w = gate * keep                            # (T,)
+                picks.append((pick, jnp.where(keep, pos_t, 0), keep, w))
+                fill = fill + jnp.sum(onehot * keep[:, None],
+                                      axis=0).astype(jnp.int32)
             masked = masked * (1.0 - onehot)
+
+        if use_sort:
+            # Sort-based slot assignment: flatten the picks round-major
+            # (flat index j*T + t) and stable-argsort by expert. A pick's
+            # rank within its expert's sorted run IS its capacity slot —
+            # identical to the scatter bookkeeping, because positions
+            # within a round count all of that round's picks and an
+            # earlier-round drop implies the expert already saturated
+            # (so later rounds drop under both schemes).
+            kt = k * t
+            expert_flat = jnp.concatenate([p for p, _, _, _ in picks])
+            order = jnp.argsort(expert_flat, stable=True)   # (kT,)
+            counts = jnp.bincount(expert_flat, length=e)    # (E,)
+            offsets = (jnp.cumsum(counts) - counts).astype(jnp.int32)
+            # inverse permutation: sorted position of each flat pick
+            inv = jnp.zeros((kt,), jnp.int32).at[order].set(
+                jnp.arange(kt, dtype=jnp.int32))
+            slot_flat = inv - offsets[expert_flat]          # rank in expert
+            keep_flat = slot_flat < capacity
+            gate_flat = jnp.concatenate([g for _, _, _, g in picks])
+            w_flat = gate_flat * keep_flat
+            slot_flat = jnp.where(keep_flat, slot_flat, 0)
+            picks = [(picks[j][0], slot_flat[j * t:(j + 1) * t],
+                      keep_flat[j * t:(j + 1) * t],
+                      w_flat[j * t:(j + 1) * t]) for j in range(k)]
 
         # Renormalise the k kept gate weights to sum 1 per token, then
         # rescale by the FULL top-k probability mass (drops included) —
@@ -140,7 +183,22 @@ class MoE(Module):
         # left on the table). Gating/combine coefficients stay f32.
         cd = input.dtype
         xc = x
-        if self.dispatch == "scatter":
+        if use_sort:
+            # Pure-gather dispatch: expert e's capacity row c holds the
+            # token of its c-th sorted pick (exactly the pick that got
+            # slot c), zero-masked past the expert's real count. No
+            # scatter traffic at all — XLA lowers this to gathers, and
+            # under EP sharding the gather feeding the sharded expert
+            # einsum still becomes the all_to_all over the expert axis.
+            token_flat = jnp.tile(jnp.arange(t, dtype=jnp.int32), k)
+            sorted_tokens = token_flat[order]               # (kT,)
+            src = offsets[:, None] + jnp.arange(capacity,
+                                                dtype=jnp.int32)[None, :]
+            valid = (jnp.arange(capacity, dtype=jnp.int32)[None, :]
+                     < jnp.minimum(counts, capacity)[:, None])  # (E, C)
+            gathered = sorted_tokens[jnp.clip(src, 0, kt - 1)]  # (E, C)
+            xe = jnp.where(valid[:, :, None], xc[gathered], 0).astype(cd)
+        elif self.dispatch == "scatter":
             # Ragged dispatch: dropped picks have w=0 and slot clamped to 0,
             # so their scatter contribution is zeroed and their gather-back
             # is weighted out.
@@ -163,7 +221,9 @@ class MoE(Module):
         ye = (jnp.einsum("ech,ehd->ecd", hdn, self.w2.astype(cd))
               + self.b2.astype(cd)[:, None, :])
 
-        if self.dispatch == "scatter":
+        if self.dispatch in ("sort", "scatter"):
+            # combine by (expert, slot) gather-back — same op order on
+            # both paths, so sort is bit-equivalent to scatter
             y = jnp.zeros((t, d), jnp.float32)
             for pick, slot, _, w in picks:
                 y = y + (w * coef)[:, None] * ye[pick, slot].astype(

@@ -149,14 +149,26 @@ METRIC_SPECS: List[MetricSpec] = [
                "Training restarts from a discovered snapshot; "
                "elastic=true when the process/device count changed "
                "(unknown = markerless legacy snapshot).", ("elastic",)),
-    # ---- kernel dispatch (ops/int8_matmul.py)
+    # ---- kernel dispatch (ops/int8_matmul.py, parallel/expert.py)
+    MetricSpec("bigdl_moe_dispatch_total", "counter",
+               "MoE forwards by dispatch formulation (path label: "
+               "sort / scatter / einsum). Counted once per eager call / "
+               "once per TRACE under jit — the branch runs at trace "
+               "time, so this records which formulation each compiled "
+               "MoE program uses, not per-step traffic. 'sort' (the "
+               "round-10 default) replaces the k-fold one-hot+cumsum+"
+               "scatter-add chains with one stable argsort plus "
+               "gathers.", ("path",)),
     MetricSpec("bigdl_int8_fallbacks_total", "counter",
-               "int8_matmul shapes that LOST the fused kernel because the "
-               "output dim is off the tile quantum (XLA dequant fallback "
-               "at ~2x the int8 byte floor; ADVICE: Qwen2 V=151936). "
-               "Counted once per eager call / once per TRACE under jit "
-               "(the decision runs at trace time), and warned once per "
-               "shape."),
+               "int8_matmul decode-shaped calls that LOST the fused "
+               "kernel because K is off the 128-lane quantum (XLA "
+               "dequant fallback at ~2x the int8 byte floor). Any output "
+               "dim takes the kernel since the round-10 full-coverage "
+               "tiling (the ceil grid masks the partial final tile), so "
+               "this stays 0 on real model shapes — V=32000 and "
+               "V=151936 included. Counted once per eager call / once "
+               "per TRACE under jit (the decision runs at trace time), "
+               "and warned once per shape."),
     # ---- compile flight recorder (telemetry/profiling.py tracked_jit)
     MetricSpec("bigdl_compiles_total", "counter",
                "XLA program compilations recorded by tracked_jit — one "

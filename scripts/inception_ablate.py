@@ -1,0 +1,215 @@
+"""Inception-v1 concat-branch + scan-LSTM perf-row dispositions
+(ROADMAP #5 leftovers; round 10).
+
+The round-5 final matrix carried two rows without a measured cap story:
+Inception-v1's "22.6% MFU, bandwidth-shaped like ResNet" (asserted by
+analogy) and the LSTM's 124.8K rec/s (no MFU at all). This script
+produces the numbers behind both rows with the ``resnet_ablate.py``
+methodology: compile the EXACT bench step (same model/criterion/
+optimizer/precision as ``bench.py``), read XLA cost_analysis FLOPs and
+bytes from the single-step program, and — the Inception-specific
+question — measure how many bytes the inception-module CONCATS actually
+move in the optimized HLO (parsed per-instruction, post-fusion), which
+bounds any branch-fusion lever. On TPU the step is also slope-timed; on
+CPU (``--cost-only``, the default off-TPU) the program-derived terms
+combine with a prior measured throughput (``--img-s`` / ``--rec-s``)
+into the row's MFU and implied HBM rate — cost_analysis is a property
+of the program, not the machine's speed.
+
+Usage: python scripts/inception_ablate.py --workload inception \
+           [--batch 256] [--img-s 4942.7] [--json out.json]
+       python scripts/inception_ablate.py --workload lstm \
+           [--batch 256] [--seq 128] [--rec-s 124800] [--json out.json]
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+                "pred": 1, "s8": 1, "u8": 1, "f64": 8, "s64": 8, "u64": 8}
+
+
+_FLOAT_DTYPES = {"f32", "bf16", "f16", "f64"}
+
+
+def _hlo_op_bytes(txt, opname):
+    """Sum output bytes of every ``opname`` instruction in optimized HLO
+    text — measured post-fusion traffic for that op (write side; the
+    read side moves the same bytes again from the operands). Returns
+    (count, float_bytes, int_bytes): the float side is the DATA
+    movement (inception's branch concats); the integer side is index
+    tensors — on the CPU backend the max-pool backward lowers to
+    index-concatenate + gather, an artifact absent from the TPU program
+    (select-and-scatter), so the lever bound uses the float term."""
+    float_total = 0.0
+    int_total = 0.0
+    n = 0
+    for m in re.finditer(
+            r"=\s*(\w+)\[([\d,]*)\][^=]*\b" + opname + r"\(", txt):
+        dt, dims = m.group(1), m.group(2)
+        if dt not in _DTYPE_BYTES:
+            continue
+        size = _DTYPE_BYTES[dt]
+        for d in dims.split(","):
+            if d:
+                size *= int(d)
+        if dt in _FLOAT_DTYPES:
+            float_total += size
+        else:
+            int_total += size
+        n += 1
+    return n, float_total, int_total
+
+
+def _build(workload, batch, seq):
+    import jax.numpy as jnp
+    import numpy as np
+    from bigdl_tpu import nn
+
+    rng = np.random.default_rng(0)
+    if workload == "inception":
+        from bigdl_tpu.models import inception
+        model = inception.build(class_num=1000)
+        data = jnp.ones((batch, 224, 224, 3), jnp.bfloat16)
+        labels = jnp.ones((batch,), jnp.float32)
+    else:
+        from bigdl_tpu.models import rnn
+        model = rnn.build_classifier(10000, 128, 256, 20, cell="lstm")
+        data = jnp.asarray(rng.integers(1, 10001, (batch, seq))
+                           .astype("float32"))
+        labels = jnp.asarray(rng.integers(1, 21, (batch,))
+                             .astype("float32"))
+    return model, nn.ClassNLLCriterion(), data, labels
+
+
+def bench_step(workload, batch, seq, fwd_only=False):
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.nn.module import functional_apply
+    from bigdl_tpu.ops.precision import DtypePolicy
+    from bigdl_tpu.optim.methods import SGD
+
+    model, crit, x, y = _build(workload, batch, seq)
+    policy = DtypePolicy.bf16()
+    optim = SGD(learningrate=0.1, momentum=0.9)
+    params = model.parameter_tree()
+    buffers = model.buffer_tree()
+    state = optim.init_state(params)
+
+    def loss_of(p, buffers):
+        p_c = policy.cast_params_for_compute(p)
+        out, nb = functional_apply(model, p_c, buffers, x, training=True)
+        return crit.apply(out, y).astype(jnp.float32), nb
+
+    if fwd_only:
+        def step(carry):
+            params, buffers, state = carry
+            loss, nb = loss_of(params, buffers)
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            leaves[0] = leaves[0] + (loss * 0).astype(leaves[0].dtype)
+            params = jax.tree_util.tree_unflatten(treedef, leaves)
+            return params, nb, state
+    else:
+        def step(carry):
+            params, buffers, state = carry
+
+            def loss_fn(p):
+                return loss_of(p, buffers)
+
+            grads, nb = jax.grad(loss_fn, has_aux=True)(params)
+            new_p, new_s = optim.update(grads, state, params)
+            return new_p, nb, new_s
+
+    single = jax.jit(step)
+    compiled = single.lower((params, buffers, state)).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    txt = compiled.as_text()
+    n_cat, cat_bytes, cat_idx_bytes = _hlo_op_bytes(txt, "concatenate")
+
+    row = {
+        "workload": workload, "batch": batch,
+        "flops_per_step": float(ca.get("flops", 0.0)),
+        "bytes_per_step": float(ca.get("bytes accessed", 0.0)),
+        "hlo_concats": n_cat,
+        "hlo_concat_out_bytes": cat_bytes,
+        "hlo_concat_index_bytes": cat_idx_bytes,
+    }
+    if workload == "lstm":
+        row["seq"] = seq
+
+    if jax.default_backend() == "tpu":
+        from roofline_pallas import _slope_timed
+
+        def make(k):
+            return jax.jit(lambda c: jax.lax.fori_loop(
+                0, k, lambda i, t: step(t), c))
+
+        t = _slope_timed(make, lambda o: o, (params, buffers, state),
+                         k_small=2, k_large=10, iters=2)
+        row["step_ms"] = round(t * 1e3, 2)
+        row["records_per_s"] = round(batch / t, 1)
+    return row
+
+
+def attach_derived(row, throughput, peak_tf):
+    """Fold a measured throughput (this run's slope-timed one on TPU, or
+    a prior on-chip number via --img-s/--rec-s on CPU) into the row:
+    step time, MFU on cost-analysis FLOPs, implied HBM rate."""
+    if not throughput:
+        return
+    t = row["batch"] / throughput
+    row["records_per_s_used"] = throughput
+    row["step_ms_derived"] = round(t * 1e3, 2)
+    row["mfu_cost_analysis"] = round(
+        row["flops_per_step"] / (t * peak_tf * 1e12), 4)
+    row["implied_gbps"] = round(row["bytes_per_step"] / t / 1e9, 1)
+    row["concat_share_of_bytes"] = round(
+        2 * row["hlo_concat_out_bytes"] / max(row["bytes_per_step"], 1), 4)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workload", default="inception",
+                    choices=("inception", "lstm"))
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--img-s", type=float, default=0.0,
+                    help="prior measured img/s (inception MFU derivation)")
+    ap.add_argument("--rec-s", type=float, default=0.0,
+                    help="prior measured rec/s (lstm MFU derivation)")
+    ap.add_argument("--peak-tf", type=float, default=197.0,
+                    help="chip peak TFLOP/s for the MFU denominator")
+    ap.add_argument("--skip-fwd", action="store_true")
+    ap.add_argument("--json", default="", help="write the BENCH JSON here")
+    args = ap.parse_args()
+
+    rows = {}
+    variants = [("full", False)] + ([] if args.skip_fwd
+                                    else [("fwd", True)])
+    for name, fwd_only in variants:
+        row = bench_step(args.workload, args.batch, args.seq,
+                         fwd_only=fwd_only)
+        if name == "full":
+            measured = row.get("records_per_s") or (
+                args.img_s if args.workload == "inception" else args.rec_s)
+            attach_derived(row, measured, args.peak_tf)
+        rows[name] = row
+        print(json.dumps({name: row}), flush=True)
+
+    art = {"schema": 1, "kind": "bigdl_tpu_perf_row_disposition",
+           "workload": args.workload, "rows": rows}
+    print(json.dumps(art))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(art, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

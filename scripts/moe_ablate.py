@@ -1,0 +1,127 @@
+"""MoE dispatch A/B: sort vs scatter vs einsum cost attribution
+(round 10 tentpole (b) evidence).
+
+Each dispatch formulation compiles ONE training step (forward + grads,
+aux loss in the graph) of the 134M-base/8-expert A/B block under the
+PR-14 ``tracked_jit`` flight recorder and reports the program's
+cost-analysis FLOPs / bytes-accessed plus structural HLO evidence (the
+sort path carries HLO sorts where the scatter path carries none, and
+its scatters shrink to the (kT,)-sized bookkeeping updates — the
+(E,C,D)-wide data movement becomes gathers). Cost rows are
+machine-independent, so ``--cost-only``
+(the default off-TPU) runs on CPU; on TPU the step is also slope-timed
+and an MFU on activated params is attached.
+
+Usage: python scripts/moe_ablate.py [--config tiny|134m-8e] \
+           [--tokens N] [--json out.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CONFIGS = {
+    # d, h, experts, k, capacity_factor, tokens
+    "134m-8e": dict(d=768, h=3072, e=8, k=2, cf=1.25, tokens=8192),
+    "tiny": dict(d=32, h=64, e=4, k=2, cf=1.25, tokens=128),
+}
+
+_DISPATCHES = ("sort", "scatter", "einsum")
+
+
+def _activated_flops_per_step(cfg):
+    """Matmul FLOPs on ACTIVATED params per training step (fwd 2x + bwd
+    4x per MAC): gate (T·D·E) + k expert FFNs (2 matmuls of D·H each on
+    T·k routed tokens) — the denominator PERF.md's MoE MFU rows use."""
+    t, d, h, e, k = (cfg["tokens"], cfg["d"], cfg["h"], cfg["e"], cfg["k"])
+    macs = t * d * e + t * k * 2 * d * h
+    return 6 * macs
+
+
+def bench_step(dispatch, cfg, seed=5):
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.nn.module import functional_apply
+    from bigdl_tpu.parallel.expert import MoE
+    from bigdl_tpu.telemetry.profiling import tracked_jit
+    from bigdl_tpu.utils.rng import manual_seed
+
+    manual_seed(seed)
+    moe = MoE(cfg["d"], cfg["h"], cfg["e"], k=cfg["k"],
+              capacity_factor=cfg["cf"], dispatch=dispatch)
+    params, buffers = moe.parameter_tree(), moe.buffer_tree()
+    x = jnp.ones((cfg["tokens"], cfg["d"]), jnp.bfloat16)
+
+    def loss(p, b, xx):
+        y, _ = functional_apply(moe, p, b, xx, training=True)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    site = f"moe_ablate.{dispatch}"
+    step = tracked_jit(jax.grad(loss), site=site)
+    g = step(params, buffers, x)
+    jax.block_until_ready(g)
+    ev = step.last_event
+    txt = step.lower(params, buffers, x).compile().as_text()
+    row = {
+        "dispatch": dispatch, "site": site,
+        "program_flops": ev.flops if ev else None,
+        "program_bytes_accessed": ev.bytes_accessed if ev else None,
+        "activated_flops_per_step": _activated_flops_per_step(cfg),
+        # structural evidence ("scatter" counts name occurrences in the
+        # compiled HLO: sort's remaining ones are the small (kT,)-sized
+        # bincount/inverse-permutation updates plus the gather transposes
+        # in the backward — not (E,C,D)-wide data scatters)
+        "hlo_sorts": txt.count("sort"),
+        "hlo_scatters": txt.count("scatter"),
+    }
+    return step, (params, buffers, x), row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="134m-8e", choices=sorted(_CONFIGS))
+    ap.add_argument("--tokens", type=int, default=0,
+                    help="override the config's token count")
+    ap.add_argument("--cost-only", action="store_true",
+                    help="skip wall-clock timing even on TPU")
+    ap.add_argument("--json", default="", help="write the BENCH JSON here")
+    args = ap.parse_args()
+
+    import jax
+    cfg = dict(_CONFIGS[args.config])
+    if args.tokens:
+        cfg["tokens"] = args.tokens
+    timed = jax.default_backend() == "tpu" and not args.cost_only
+
+    rows = []
+    for dispatch in _DISPATCHES:
+        step, feed, row = bench_step(dispatch, cfg)
+        if timed:
+            from bigdl_tpu.telemetry.profiling import mfu
+            for _ in range(2):
+                jax.block_until_ready(step(*feed))  # warm
+            t0 = time.perf_counter()
+            iters = 10
+            for _ in range(iters):
+                g = step(*feed)
+            jax.block_until_ready(g)
+            row["step_seconds"] = (time.perf_counter() - t0) / iters
+            row["mfu_activated"] = mfu(row["activated_flops_per_step"],
+                                       row["step_seconds"])
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    art = {"schema": 1, "kind": "bigdl_tpu_moe_ablate",
+           "config": {"name": args.config, **cfg}, "rows": rows}
+    print(json.dumps(art))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(art, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -139,6 +139,65 @@ def test_no_fused_self_ab_runs(monkeypatch, capsys):
     assert not any("fused" in n for n, *_ in log2)
 
 
+def _load_script(name):
+    """Import a scripts/ module the way the CLI runs it (scripts/ on
+    sys.path so roofline_pallas resolves)."""
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(scripts, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_int8_decode_bench_cost_only_emits_cost_keys(monkeypatch, capsys,
+                                                     tmp_path):
+    """Round-10 CI gate: the --cost-only mode runs on the CPU tier and
+    the BENCH JSON carries the flight-recorder cost-analysis keys for
+    every weight variant, with zero int8 fallbacks."""
+    mod = _load_script("int8_decode_bench")
+    out_json = tmp_path / "int8_cost.json"
+    monkeypatch.setattr(sys, "argv", [
+        "int8_decode_bench.py", "--cost-only", "--config", "tiny",
+        "--json", str(out_json)])
+    mod.main()
+    art = json.loads(out_json.read_text())
+    assert art["kind"] == "bigdl_tpu_int8_decode_cost"
+    rows = art["int8_decode_cost"]
+    for variant in ("fp32", "bf16", "int8"):
+        assert rows[variant]["program_flops"] > 0
+        assert rows[variant]["program_bytes_accessed"] > 0
+        assert rows[variant]["site"] == f"int8_decode.{variant}"
+    assert rows["int8_fallbacks_delta"] == 0
+
+
+def test_moe_ablate_emits_cost_rows_for_all_dispatches(monkeypatch,
+                                                       capsys, tmp_path):
+    """The moe_ablate mode must produce one cost row per dispatch
+    formulation with cost-analysis keys and the structural HLO evidence
+    (only the sort path carries HLO sorts)."""
+    mod = _load_script("moe_ablate")
+    out_json = tmp_path / "moe_ablate.json"
+    monkeypatch.setattr(sys, "argv", [
+        "moe_ablate.py", "--config", "tiny", "--cost-only",
+        "--json", str(out_json)])
+    mod.main()
+    art = json.loads(out_json.read_text())
+    assert art["kind"] == "bigdl_tpu_moe_ablate"
+    rows = {r["dispatch"]: r for r in art["rows"]}
+    assert set(rows) == {"sort", "scatter", "einsum"}
+    for r in rows.values():
+        assert r["program_flops"] > 0
+        assert r["program_bytes_accessed"] > 0
+        assert r["activated_flops_per_step"] > 0
+    assert rows["sort"]["hlo_sorts"] > 0
+    assert rows["scatter"]["hlo_sorts"] == 0
+    assert rows["einsum"]["hlo_sorts"] == 0
+
+
 def test_all_mode_one_line_per_workload(monkeypatch, capsys):
     # --all emits one JSON line per BASELINE workload, falling down each
     # model's ladder independently; dead-TPU probe limits it to CPU

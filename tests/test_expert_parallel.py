@@ -70,6 +70,63 @@ class TestMoELocal:
                                    np.asarray(b.forward(x)),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.parametrize("cf", [0.5, 1.25])
+    def test_three_way_dispatch_equivalence_forward(self, cf):
+        """Round-10 tentpole gate: sort == scatter BIT-FOR-BIT (same
+        routing, drop semantics, and combine op order — including real
+        drops at cf<1 and the renormalised combine weights), and both
+        match the dense einsum formulation to float tolerance. Two
+        capacity factors cover both regimes (real drops / headroom);
+        cf=1.0 boundary behaviour is pinned by the scatter/einsum pair
+        test above."""
+        np.random.seed(7)
+        ms = {}
+        for disp in ("sort", "scatter", "einsum"):
+            m = MoE(16, 32, n_experts=4, k=2, capacity_factor=cf,
+                    dispatch=disp).evaluate_mode()
+            if ms:
+                m.load_parameter_tree(next(iter(ms.values()))
+                                      .parameter_tree())
+            ms[disp] = m
+        x = _rand(37, 16)
+        outs = {d: np.asarray(m.forward(x)) for d, m in ms.items()}
+        np.testing.assert_array_equal(outs["sort"], outs["scatter"])
+        np.testing.assert_allclose(outs["sort"], outs["einsum"],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_sort_matches_scatter_gradients_bitexact(self):
+        """Gradients through the sort path's gathers must equal the
+        scatter path's on every parameter leaf — at a capacity factor
+        that forces real drops, with the aux loss in the graph."""
+        np.random.seed(11)
+        x = _rand(29, 16)
+        grads, shared = {}, None
+        for disp in ("sort", "scatter"):
+            m = MoE(16, 32, n_experts=4, k=2, capacity_factor=0.75,
+                    aux_loss_weight=0.1, dispatch=disp)
+            if shared is None:
+                shared = m.parameter_tree()
+            else:
+                m.load_parameter_tree(shared)
+            params, buffers = m.parameter_tree(), m.buffer_tree()
+
+            def loss(p):
+                y, _ = functional_apply(m, p, buffers, x, training=True)
+                return jnp.sum(y * y)
+
+            grads[disp] = jax.grad(loss)(params)
+        for name, g in grads["sort"].items():
+            np.testing.assert_array_equal(
+                np.asarray(g), np.asarray(grads["scatter"][name]),
+                err_msg=f"grad mismatch on {name}")
+
+    def test_dispatch_counter_counts_paths(self):
+        from bigdl_tpu.telemetry import get_registry, instruments
+        fam = instruments(get_registry()).moe_dispatch_total
+        before = fam.labels(path="sort").value
+        MoE(8, 8, n_experts=2, k=1).evaluate_mode().forward(_rand(4, 8))
+        assert fam.labels(path="sort").value == before + 1
+
     def test_capacity_overflow_at_realistic_token_count(self):
         # 8192 tokens, 8 experts, cf=1.0: the ragged path must (a) never
         # blow up memory with a (T,E,C) mask (8192*8*2048 floats = 512MB

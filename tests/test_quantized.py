@@ -242,11 +242,12 @@ class TestInt8MatmulKernel:
         np.testing.assert_array_equal(np.asarray(y).reshape(6, 256),
                                       np.asarray(flat))
 
-    def test_indivisible_falls_back(self):
+    def test_off_lane_quantum_falls_back(self):
         from bigdl_tpu.ops.int8_matmul import int8_matmul, kernel_applicable
         x, q, s = self._mats(2, 100, 60, seed=7)
-        assert not kernel_applicable(2, 100, 60)
-        y = int8_matmul(x, q, s)  # XLA path, still correct
+        assert not kernel_applicable(2, 100, 60)  # K=100 off the quantum
+        with pytest.warns(RuntimeWarning, match="lane quantum"):
+            y = int8_matmul(x, q, s)  # XLA path, still correct
         want = x @ (np.asarray(q, np.float32) * np.asarray(s)).T
         np.testing.assert_allclose(np.asarray(y, np.float32), want,
                                    rtol=2e-2, atol=3e-2)
@@ -276,9 +277,11 @@ class TestInt8MatmulKernel:
 
 
 class TestLostKernelWarning:
-    """ADVICE satellite: a vocab off the tile quantum must not lose the
-    fused kernel SILENTLY — one loud warning naming shape + quantum,
-    plus a bigdl_int8_fallbacks_total count per dispatch."""
+    """A decode-shaped matmul must not lose the fused kernel SILENTLY —
+    one loud warning naming shape + quantum, plus a
+    bigdl_int8_fallbacks_total count per dispatch. Since the round-10
+    full-coverage tiling any output dim takes the kernel, so the only
+    warned class left is K off the 128-lane quantum."""
 
     def _call(self, out_dim, kdim=128, m=2):
         from bigdl_tpu.ops.int8_matmul import int8_matmul
@@ -294,25 +297,84 @@ class TestLostKernelWarning:
         monkeypatch.setattr(mod, "_FALLBACK_WARNED", set())
         counter = instruments(get_registry()).int8_fallbacks_total
         before = counter.value
-        # V=150: no tile candidate divides it — the Qwen2-shaped loss
+        # K=100: off the 128-lane quantum — the only remaining loss class
         with pytest.warns(RuntimeWarning) as rec:
-            out = self._call(150)
-        assert out.shape == (2, 150)
+            out = self._call(256, kdim=100)
+        assert out.shape == (2, 256)
         msgs = [str(w.message) for w in rec
-                if "tile quantum" in str(w.message)]
+                if "lane quantum" in str(w.message)]
         assert len(msgs) == 1
-        assert "out_dim=150" in msgs[0] and "256" in msgs[0]
+        assert "K=100" in msgs[0] and "128" in msgs[0]
         # same shape again: counted, NOT re-warned
         with warnings_mod.catch_warnings():
             warnings_mod.simplefilter("error", RuntimeWarning)
-            self._call(150)
+            self._call(256, kdim=100)
         assert counter.value == before + 2
 
-    def test_aligned_vocab_and_big_m_stay_silent(self, monkeypatch):
+    def test_any_output_dim_and_big_m_stay_silent(self, monkeypatch):
         import warnings as warnings_mod
         from bigdl_tpu.ops import int8_matmul as mod
+        from bigdl_tpu.ops.int8_matmul import kernel_applicable
         monkeypatch.setattr(mod, "_FALLBACK_WARNED", set())
+        # the pre-round-10 Qwen2-shaped loss: O=150 now TAKES the kernel
+        assert kernel_applicable(2, 128, 150)
         with warnings_mod.catch_warnings():
             warnings_mod.simplefilter("error", RuntimeWarning)
             self._call(256)          # on-quantum: kernel path, no warning
+            self._call(150)          # off-quantum O: covered since round 10
             self._call(150, m=512)   # big-M prefill fallback: deliberate
+
+
+class TestKernelCoverage:
+    """Round-10 tentpole regression gate: ANY (O, K%128==0) shape takes
+    the Pallas path — real LM-head vocabs (V=32000 at 1024-row tiles,
+    Qwen2's V=151936 at 0.4% tail padding), GQA k/v slices, and
+    odd-multiple-of-128 remainder shapes — with numerics matching the
+    reference dequant path and ``bigdl_int8_fallbacks_total`` frozen at
+    zero across a quantized 134M-config GQA decode step."""
+
+    # the Qwen2 vocab runs at K=128 to keep the CPU-tier cost down: the
+    # coverage point is the 149x1024 ceil grid with the 640-row masked
+    # tail, which is K-independent
+    @pytest.mark.parametrize("o,k", [(32000, 768), (151936, 128),
+                                     (256, 768), (1152, 768), (1100, 768)])
+    def test_parity_vs_reference_dequant(self, o, k):
+        from bigdl_tpu.ops.int8_matmul import (int8_matmul,
+                                               kernel_applicable, _pick_to)
+        assert kernel_applicable(2, k, o)
+        rng = np.random.RandomState(o % 9973)
+        x = jnp.asarray(rng.randn(2, k).astype(np.float32))
+        w = rng.randn(o, k).astype(np.float32) * 0.1
+        q, s = quantize_array(jnp.asarray(w), 0)
+        got = np.asarray(int8_matmul(x, q, s), np.float32)
+        want = np.asarray(
+            jnp.matmul(x.astype(jnp.bfloat16),
+                       (q.astype(jnp.bfloat16)
+                        * s.astype(jnp.bfloat16)).T).astype(jnp.float32))
+        assert got.shape == (2, o) and np.isfinite(got).all()
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=3e-2)
+        # the big vocabs ride the LARGEST tile, not the old 256-row one
+        if o >= 32000:
+            assert _pick_to(o, k) == 1024
+
+    def test_no_fallbacks_on_134m_config_gqa_decode(self):
+        """Every matmul in the 134M-config GQA serving stack (embed 768,
+        12 heads / 4 kv heads, SwiGLU ffn 3072, tied V=32000 head) must
+        take the kernel: the fallback counter may not move and no
+        RuntimeWarning may fire across quantize + a decode-shaped
+        forward. One layer — per-layer shapes repeat."""
+        import warnings as warnings_mod
+        from bigdl_tpu.telemetry import get_registry, instruments
+        model = transformer.build_lm(
+            32_000, 768, 12, 3072, num_layers=1, max_len=32, rope=True,
+            num_kv_heads=4, norm="rms", activation="swiglu", bias=False,
+            tie_embeddings=True)
+        counter = instruments(get_registry()).int8_fallbacks_total
+        before = counter.value
+        with warnings_mod.catch_warnings():
+            warnings_mod.simplefilter("error", RuntimeWarning)
+            qmodel = quantize_model(model)
+            logp = qmodel.predict(jnp.ones((1, 4)))
+        assert logp.shape == (1, 4, 32_000)
+        assert np.isfinite(np.asarray(logp, np.float32)).all()
+        assert counter.value == before
