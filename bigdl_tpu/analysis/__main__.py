@@ -11,6 +11,7 @@ format.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -95,10 +96,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "set only — run the full gate before merging)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule table and exit")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="bypass the content-hash result cache "
+                             "(GRAFTLINT_NO_CACHE=1 equivalent)")
+    parser.add_argument("--comm-model", metavar="PATH", default=None,
+                        help="write the static collective byte model "
+                             "(COMM_MODEL.json; '-' for stdout) and exit")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         print(rule_table())
+        return 0
+    if args.comm_model is not None:
+        from bigdl_tpu.analysis import commcost
+        if args.comm_model == "-":
+            print(json.dumps(commcost.build_model(), indent=2,
+                             sort_keys=True))
+        else:
+            commcost.write_model(args.comm_model)
+            print(f"graftlint: collective byte model written to "
+                  f"{args.comm_model}", file=sys.stderr)
         return 0
     paths = args.paths or default_paths()
     missing = [p for p in paths if not os.path.exists(p)]
@@ -117,7 +134,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                       f"{args.changed}", file=sys.stderr)
         # lint_paths validates --select/--ignore codes via select_rules
         results = lint_paths(paths, select=args.select, ignore=args.ignore,
-                             files=files)
+                             files=files,
+                             use_cache=False if args.no_cache else None)
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2

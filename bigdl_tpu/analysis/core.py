@@ -523,7 +523,7 @@ _HOST_METHODS = {"item", "tolist", "block_until_ready"}
 _ARRAY_NAMESPACES = ("jnp.", "jax.", "lax.")
 # ...except these, which return static Python values even under trace
 _STATIC_JAX_CALLS = {
-    "jax.lax.axis_size", "lax.axis_size", "jax.device_count",
+    "jax.lax.axis_size", "lax.axis_size", "axis_size", "jax.device_count",
     "jax.local_device_count", "jax.process_count", "jax.process_index",
     "jax.default_backend", "jax.devices", "jax.local_devices",
     "jax.eval_shape", "jax.ShapeDtypeStruct",
@@ -1012,19 +1012,25 @@ def lint_file(path: str,
         return lint_source(path, f.read(), rules)
 
 
-def _lint_program(files: Sequence[str],
-                  rules: Sequence[Rule]) -> List[FileResult]:
+def _lint_program(files: Sequence[str], rules: Sequence[Rule],
+                  sources: Optional[Dict[str, str]] = None
+                  ) -> List[FileResult]:
     """Whole-program pass: parse every file once, build the shared
     ProgramIndex, then run the rules per file with cross-module facts
-    attached. Unparseable files report JG000 and stay out of the index."""
+    attached. Unparseable files report JG000 and stay out of the index.
+    ``sources`` supplies preloaded file contents (the result cache has
+    already read them for hashing)."""
     ctxs: List[FileContext] = []
     results_by_path: Dict[str, FileResult] = {}
     order: List[str] = []
     for path in files:
         order.append(path)
         try:
-            with open(path, encoding="utf-8") as f:
-                source = f.read()
+            if sources is not None and path in sources:
+                source = sources[path]
+            else:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
             ctxs.append(FileContext.parse(path, source))
         except SyntaxError as e:
             results_by_path[path] = _syntax_error_result(path, e)
@@ -1073,16 +1079,39 @@ def select_rules(select: Optional[Iterable[str]] = None,
 def lint_paths(paths: Sequence[str],
                select: Optional[Iterable[str]] = None,
                ignore: Optional[Iterable[str]] = None,
-               files: Optional[Sequence[str]] = None) -> List[FileResult]:
+               files: Optional[Sequence[str]] = None,
+               use_cache: Optional[bool] = None) -> List[FileResult]:
     """Lint every ``.py`` file under the given files/directories with the
     selected rules as ONE whole program (cross-module facts propagate
     between all of them); one FileResult per file, in walk order.
     ``files`` overrides the walk with an explicit file list (the CLI's
-    ``--changed`` filter)."""
+    ``--changed`` filter). Results are served from the content-hash
+    cache (analysis/cache.py) when every input is byte-identical to a
+    stored pass; ``use_cache=False`` (or GRAFTLINT_NO_CACHE=1) forces a
+    fresh pass."""
+    from bigdl_tpu.analysis import cache as _cache
+
     rules = select_rules(select, ignore)
     if files is None:
         files = list(iter_python_files(paths))
-    return _lint_program(files, rules)
+    if use_cache is None:
+        use_cache = _cache.enabled()
+    if not use_cache:
+        return _lint_program(files, rules)
+    sources: Dict[str, str] = {}
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources[path] = f.read()
+        except OSError:
+            pass  # _lint_program re-raises on the real read
+    key = _cache.program_key(sources, [r.code for r in rules])
+    hit = _cache.lookup(key, list(files))
+    if hit is not None:
+        return hit
+    results = _lint_program(files, rules, sources=sources)
+    _cache.store(key, results)
+    return results
 
 
 # --------------------------------------------------------------------------

@@ -78,6 +78,9 @@ class FuncSummary:
     sync_params: Set[int] = field(default_factory=set)
     key_params: Set[int] = field(default_factory=set)
     returns_jit: bool = False
+    # positions the RETURNED wrapper donates (``return jax.jit(f,
+    # donate_argnums=(0,))`` -> (0,)); empty when not a donating builder
+    donates: Tuple[int, ...] = ()
 
 
 @dataclass
@@ -95,6 +98,9 @@ class ModuleRecord:
     functions: Dict[str, ast.AST] = field(default_factory=dict)
     # module-level NAME = "literal" string constants (mesh-axis idiom)
     str_constants: Dict[str, str] = field(default_factory=dict)
+    # module-level NAME = literal int constants (config-dim idiom:
+    # EMBED = 512 — the shape interpreter resolves these to dims)
+    int_constants: Dict[str, int] = field(default_factory=dict)
 
     def qualname_of(self, node: ast.AST) -> Optional[str]:
         for qual, fn in self.functions.items():
@@ -137,10 +143,12 @@ def _index_module(name: str, path: str, tree: ast.Module) -> ModuleRecord:
     for node in tree.body:
         if isinstance(node, ast.Assign) and len(node.targets) == 1:
             tgt = node.targets[0]
-            if (isinstance(tgt, ast.Name) and isinstance(node.value,
-                                                         ast.Constant)
-                    and isinstance(node.value.value, str)):
-                rec.str_constants[tgt.id] = node.value.value
+            if isinstance(tgt, ast.Name) and isinstance(node.value,
+                                                        ast.Constant):
+                if isinstance(node.value.value, str):
+                    rec.str_constants[tgt.id] = node.value.value
+                elif type(node.value.value) is int:
+                    rec.int_constants[tgt.id] = node.value.value
     for node in tree.body:
         if isinstance(node, _FUNC_TYPES):
             rec.functions[node.name] = node
@@ -243,6 +251,21 @@ class ProgramIndex:
                 return trec.str_constants.get(sym)
         return None
 
+    def resolve_int_constant(self, module: str, name: str) -> Optional[int]:
+        """``EMBED`` -> ``512``, following one from-import hop (mirror of
+        :meth:`resolve_str_constant` for the shape interpreter)."""
+        rec = self.modules.get(module)
+        if rec is None:
+            return None
+        if name in rec.int_constants:
+            return rec.int_constants[name]
+        if name in rec.sym_imports:
+            tmod, sym = rec.sym_imports[name]
+            trec = self.modules.get(tmod)
+            if trec is not None:
+                return trec.int_constants.get(sym)
+        return None
+
     def summary_for_call(self, module: str, callee: str,
                          cls: Optional[str] = None
                          ) -> Optional[Tuple[FuncKey, FuncSummary]]:
@@ -340,6 +363,10 @@ class ProgramIndex:
             if isinstance(node, ast.Return) and node.value is not None:
                 if self._is_jit_expr(node.value, fn):
                     summ.returns_jit = True
+                    donated = self._jit_expr_donates(node.value, fn)
+                    if donated:
+                        summ.donates = tuple(sorted(set(summ.donates)
+                                                    | set(donated)))
             if not isinstance(node, ast.Call):
                 continue
             callee = dotted_name(node.func)
@@ -379,6 +406,41 @@ class ProgramIndex:
                             return True
         return False
 
+    def _jit_expr_donates(self, expr: ast.expr,
+                          fn: ast.AST) -> Tuple[int, ...]:
+        """Literal ``donate_argnums`` positions of the jit wrapper built
+        by ``expr`` (a direct wrapper call or a local name bound to one)."""
+        call = None
+        if isinstance(expr, ast.Call) \
+                and dotted_name(expr.func) in _JIT_WRAPPERS:
+            call = expr
+        elif isinstance(expr, ast.Name):
+            for node in iter_own_statements(fn):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and dotted_name(node.value.func) in _JIT_WRAPPERS):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name) and tgt.id == expr.id:
+                            call = node.value
+        if call is None:
+            return ()
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            if isinstance(kw.value, ast.Constant) \
+                    and type(kw.value.value) is int:
+                return (kw.value.value,)
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                out = []
+                for el in kw.value.elts:
+                    if isinstance(el, ast.Constant) \
+                            and type(el.value) is int:
+                        out.append(el.value)
+                    else:
+                        return ()  # non-literal position: give up
+                return tuple(sorted(set(out)))
+        return ()
+
     def _propagate_summary(self, key: FuncKey) -> bool:
         mod, qual = key
         rec = self.modules[mod]
@@ -390,12 +452,17 @@ class ProgramIndex:
         changed = False
         for node in iter_own_statements(fn):
             if isinstance(node, ast.Return) and node.value is not None \
-                    and not summ.returns_jit \
                     and isinstance(node.value, ast.Call):
                 resolved = self.summary_for_call(
                     mod, dotted_name(node.value.func) or "", cls)
                 if resolved is not None and resolved[1].returns_jit:
-                    summ.returns_jit = changed = True
+                    if not summ.returns_jit:
+                        summ.returns_jit = changed = True
+                    if resolved[1].donates and set(resolved[1].donates) \
+                            - set(summ.donates):
+                        summ.donates = tuple(sorted(
+                            set(summ.donates) | set(resolved[1].donates)))
+                        changed = True
             if not isinstance(node, ast.Call):
                 continue
             resolved = self.summary_for_call(mod,

@@ -14,6 +14,7 @@ from bigdl_tpu.analysis.rules import (  # noqa: F401
     jit_in_loop,
     mutable_defaults,
     prng,
+    shapeaware,
     sharding,
     side_effects,
     static_args,

@@ -1,0 +1,572 @@
+"""JG018–JG020 — shape-aware rules powered by the abstract interpreter.
+
+These three close the gap between graftlint v2's *name-level* checks and
+the failures that only show up at trace/run time on real meshes:
+
+- **JG018** — a PartitionSpec axis whose mesh size cannot evenly divide
+  the statically known array dim. GSPMD does not error: it silently
+  pads every shard to ``ceil(dim / size)`` and ships the padding over
+  the wire on every collective — the silent-padding class.
+- **JG019** — a runtime-derived value (``len()`` of request data and
+  arithmetic over it) reaching a jit compile cache, either through a
+  ``static_argnums`` position or as an array whose *shape* carries the
+  dynamic length. This is the general, statically detected form of the
+  PR-15 compile storm; bucketing (an unmodeled call like
+  ``pow2_bucket``or ``% CHUNK``) launders the value and is clean.
+- **JG020** — donated-buffer liveness across functions: JG007 only sees
+  ``f = jax.jit(g, donate_argnums=...)`` bound locally; JG020 tracks
+  donating wrappers held on ``self`` attributes and built by (possibly
+  cross-module) builder functions whose ``FuncSummary.donates`` says
+  the returned wrapper donates.
+
+All three inherit the precision-over-recall stance: unresolvable
+meshes, shapes, and callees are skipped, never guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from bigdl_tpu.analysis.core import (FileContext, Finding, Rule, _FUNC_TYPES,
+                                     _JIT_WRAPPERS, _positional_params,
+                                     _unwrap_partial, dotted_name, register)
+from bigdl_tpu.analysis.rules.donation import _donated_positions
+from bigdl_tpu.analysis.rules.sharding import (_PSPEC_LASTS, _SHARD_MAP,
+                                               _axis_name_of, _kw,
+                                               _resolver_for)
+from bigdl_tpu.analysis.shapes import DYN, shape_env
+
+
+def _enclosing_fn(ctx: FileContext, node: ast.AST) -> Optional[ast.AST]:
+    cur = ctx.jit_index.parent.get(node)
+    while cur is not None and not isinstance(cur, _FUNC_TYPES):
+        cur = ctx.jit_index.parent.get(cur)
+    return cur
+
+
+def _in_loop(ctx: FileContext, node: ast.AST) -> bool:
+    """Is ``node`` lexically inside a loop (stopping at the enclosing
+    function boundary)?"""
+    cur = ctx.jit_index.parent.get(node)
+    while cur is not None and not isinstance(cur, _FUNC_TYPES):
+        if isinstance(cur, (ast.For, ast.While)):
+            return True
+        cur = ctx.jit_index.parent.get(cur)
+    return False
+
+
+def _loop_reachable(ctx: FileContext, call: ast.Call) -> bool:
+    if _in_loop(ctx, call):
+        return True
+    fn = _enclosing_fn(ctx, call)
+    if fn is None or ctx.program is None or ctx.module is None:
+        return False
+    return ctx.program.called_from_loop(ctx.module, fn)
+
+
+# ---------------------------------------------------------------------------
+# JG018 — sharded-axis divisibility
+# ---------------------------------------------------------------------------
+
+def _spec_dim_axes(spec_expr: ast.expr, ctx: FileContext
+                   ) -> Optional[List[Optional[Tuple[str, ...]]]]:
+    """``P("data", None, ("expert", "tensor"))`` -> per-dim axis tuples.
+    ``None`` per dim when that dim's axes are not statically resolvable;
+    returns None when the expression is not a P(...) literal at all."""
+    if not (isinstance(spec_expr, ast.Call)
+            and (dotted_name(spec_expr.func) or "").rsplit(".", 1)[-1]
+            in _PSPEC_LASTS):
+        return None
+    dims: List[Optional[Tuple[str, ...]]] = []
+    for arg in spec_expr.args:
+        if isinstance(arg, ast.Constant) and arg.value is None:
+            dims.append(())
+            continue
+        elts = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) else [arg]
+        axes: List[str] = []
+        ok = True
+        for elt in elts:
+            name = _axis_name_of(elt, ctx)
+            if name is None:
+                ok = False
+                break
+            axes.append(name)
+        dims.append(tuple(axes) if ok else None)
+    return dims
+
+
+@register
+class ShardDivisibilityRule(Rule):
+    """GSPMD never rejects a spec whose axis size does not divide the
+    dim it shards: every shard is padded to ``ceil(dim / size)`` and
+    the padding rides every downstream collective — on a pod this is a
+    silent, permanent bandwidth tax that no test fails on. When the
+    mesh's axis SIZES and the array's dims both resolve statically, the
+    divisibility check is a lint-time error instead. Dims derived from
+    runtime data or unresolvable meshes are skipped.
+    """
+
+    code = "JG018"
+    summary = ("PartitionSpec shards a statically known dim that the mesh "
+               "axis size cannot evenly divide (silent GSPMD padding)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        resolver = _resolver_for(ctx)
+        for call in ctx.walk():
+            if not isinstance(call, ast.Call):
+                continue
+            callee = dotted_name(call.func) or ""
+            if callee in _SHARD_MAP:
+                yield from self._shard_map_site(ctx, resolver, call)
+                continue
+            last = callee.rsplit(".", 1)[-1]
+            if last in ("device_put", "with_sharding_constraint") \
+                    and len(call.args) >= 2:
+                yield from self._named_sharding_site(ctx, resolver, call)
+
+    # -- shard_map in_specs vs call-site argument shapes -----------------
+    def _shard_map_site(self, ctx: FileContext, resolver,
+                        call: ast.Call) -> Iterator[Finding]:
+        mesh_expr = _kw(call, "mesh") or (
+            call.args[1] if len(call.args) > 1 else None)
+        if mesh_expr is None:
+            return
+        sizes = resolver.sizes_of(mesh_expr, call)
+        if not sizes:
+            return
+        sizes = dict(sizes)
+        in_specs = _kw(call, "in_specs")
+        if in_specs is None:
+            return
+        spec_entries = in_specs.elts if isinstance(
+            in_specs, (ast.Tuple, ast.List)) else [in_specs]
+        per_arg = [_spec_dim_axes(e, ctx) for e in spec_entries]
+        for invocation in self._invocations(ctx, call):
+            fn = _enclosing_fn(ctx, invocation)
+            if fn is None:
+                continue
+            env = shape_env(ctx, fn)
+            for i, arg in enumerate(invocation.args):
+                if i >= len(per_arg) or per_arg[i] is None:
+                    continue
+                yield from self._check_arg(ctx, invocation, env, arg,
+                                           per_arg[i], sizes)
+
+    def _invocations(self, ctx: FileContext,
+                     sm_call: ast.Call) -> Iterator[ast.Call]:
+        """Call sites of the callable a shard_map(...) expression builds:
+        direct invocation, or calls of the local name it is bound to
+        (possibly through a jit wrapper around the shard_map)."""
+        node: ast.AST = sm_call
+        parent = ctx.jit_index.parent.get(node)
+        # unwrap jax.jit(shard_map(...)) — argument positions pass through
+        if isinstance(parent, ast.Call) and parent.args \
+                and parent.args[0] is node \
+                and dotted_name(parent.func) in _JIT_WRAPPERS:
+            node = parent
+            parent = ctx.jit_index.parent.get(node)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            yield parent
+            return
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], ast.Name):
+            name = parent.targets[0].id
+            scope = _enclosing_fn(ctx, parent)
+            for n in ctx.walk():
+                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                        and n.func.id == name \
+                        and (scope is None
+                             or _enclosing_fn(ctx, n) is scope):
+                    yield n
+
+    def _check_arg(self, ctx: FileContext, site: ast.Call, env,
+                   arg: ast.expr,
+                   dim_axes: Sequence[Optional[Tuple[str, ...]]],
+                   sizes: Dict[str, int]) -> Iterator[Finding]:
+        shape = env.shape_of(arg)
+        if shape is None:
+            return
+        for d, axes in enumerate(dim_axes):
+            if not axes or d >= len(shape):
+                continue
+            if any(a not in sizes for a in axes):
+                continue  # axis-name drift is JG010's finding, not ours
+            group = 1
+            for a in axes:
+                group *= sizes[a]
+            dim = shape[d]
+            if group > 1 and isinstance(dim, int) and dim % group != 0:
+                axis_txt = "x".join(axes)
+                yield self.finding(
+                    ctx, site,
+                    f"dim {d} of this argument is {dim}, which axis "
+                    f"'{axis_txt}' (size {group}) cannot evenly divide — "
+                    f"GSPMD silently pads every shard to "
+                    f"{-(-dim // group)} and ships the padding on every "
+                    f"collective")
+
+    # -- device_put / with_sharding_constraint with a NamedSharding ------
+    def _named_sharding_site(self, ctx: FileContext, resolver,
+                             call: ast.Call) -> Iterator[Finding]:
+        ns = call.args[1]
+        if not (isinstance(ns, ast.Call)
+                and (dotted_name(ns.func) or "").rsplit(".", 1)[-1]
+                == "NamedSharding" and len(ns.args) >= 2):
+            return
+        sizes = resolver.sizes_of(ns.args[0], call)
+        if not sizes:
+            return
+        sizes = dict(sizes)
+        dim_axes = _spec_dim_axes(ns.args[1], ctx)
+        if dim_axes is None:
+            return
+        fn = _enclosing_fn(ctx, call)
+        if fn is None:
+            return
+        env = shape_env(ctx, fn)
+        yield from self._check_arg(ctx, call, env, call.args[0], dim_axes,
+                                   sizes)
+
+
+# ---------------------------------------------------------------------------
+# JG019 — dynamic value reaching a jit compile cache
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _JitDecl:
+    """One jit-wrapped callable visible by name in this file."""
+
+    static_pos: Set[int] = field(default_factory=set)
+    static_names: Set[str] = field(default_factory=set)
+    shift_self: bool = False  # decorated method: call args shift by 1
+
+
+def _static_decl_literals(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    pos: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        values = kw.value.elts if isinstance(
+            kw.value, (ast.Tuple, ast.List)) else [kw.value]
+        for v in values:
+            if not isinstance(v, ast.Constant):
+                continue
+            if isinstance(v.value, int) and not isinstance(v.value, bool):
+                pos.add(v.value)
+            elif isinstance(v.value, str):
+                names.add(v.value)
+    return pos, names
+
+
+def _jit_callables(ctx: FileContext) -> Dict[str, _JitDecl]:
+    """Callable name -> jit declaration, for every wrapper we can see:
+    decorated defs (``f`` and ``self.f``), local/attr assignments of a
+    wrapper call (``step = jax.jit(...)``, ``self._step = tracked_jit(
+    ...)``), and names bound from builder calls whose cross-module
+    summary says they return a jit wrapper."""
+    table: Dict[str, _JitDecl] = {}
+    idx = ctx.jit_index
+    for fn in idx.functions:
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call):
+                callee = dotted_name(dec.func) or _unwrap_partial(dec)
+            else:
+                callee = dotted_name(dec)  # bare @jax.jit
+            if callee not in _JIT_WRAPPERS:
+                continue
+            pos, names = _static_decl_literals(dec) \
+                if isinstance(dec, ast.Call) else (set(), set())
+            decl = _JitDecl(pos, names,
+                            _positional_params(fn)[:1] == ["self"])
+            table[fn.name] = decl
+            if decl.shift_self:
+                table[f"self.{fn.name}"] = decl
+    for node in ctx.walk():
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.value, ast.Call)):
+            continue
+        tgt = node.targets[0]
+        tname = dotted_name(tgt) if isinstance(
+            tgt, (ast.Name, ast.Attribute)) else None
+        if tname is None:
+            continue
+        callee = dotted_name(node.value.func) or ""
+        if callee in _JIT_WRAPPERS:
+            pos, names = _static_decl_literals(node.value)
+            table[tname] = _JitDecl(pos, names)
+        elif ctx.program is not None and ctx.module is not None:
+            fn = _enclosing_fn(ctx, node)
+            cls = idx.enclosing_class_name(fn) if fn is not None else None
+            resolved = ctx.program.summary_for_call(ctx.module, callee, cls)
+            if resolved is not None and resolved[1].returns_jit:
+                table[tname] = _JitDecl()  # signature-keyed only
+    return table
+
+
+@register
+class DynamicJitKeyRule(Rule):
+    """A jit cache is keyed on its static argument VALUES and its traced
+    arguments' SHAPES — a value derived from runtime data (``len()`` of
+    a request, a queue, a prompt) reaching either one compiles a new
+    program per distinct value: the compile-storm class PR 15 fixed
+    post-hoc, detected statically. Bucketing launders the value (any
+    unmodeled call such as ``pow2_bucket``, or ``%`` by a constant), so
+    the chunked/bucketed idioms are clean. Only loop-reachable call
+    sites fire — a one-shot call cannot storm.
+    """
+
+    code = "JG019"
+    summary = ("runtime-derived (len-of-data) value reaches a jit compile "
+               "cache via static_argnums or an argument's shape")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        table = ctx.rule_cache("shapeaware._jit_callables",
+                               lambda: _jit_callables(ctx))
+        if not table:
+            return
+        for call in ctx.walk():
+            if not isinstance(call, ast.Call):
+                continue
+            cname = dotted_name(call.func)
+            decl = table.get(cname or "")
+            if decl is None:
+                continue
+            fn = _enclosing_fn(ctx, call)
+            if fn is None or not _loop_reachable(ctx, call):
+                continue
+            env = shape_env(ctx, fn)
+            shift = 1 if (decl.shift_self
+                          and cname.startswith(("self.", "cls."))) else 0
+            for j, arg in enumerate(call.args):
+                if j + shift in decl.static_pos:
+                    if env.scalar_of(arg) is DYN:
+                        yield self.finding(
+                            ctx, call,
+                            f"a runtime-derived value (len() of runtime "
+                            f"data) reaches static position {j} of "
+                            f"'{cname}' — every distinct value compiles "
+                            f"a new program; bucket it first")
+                    continue
+                yield from self._shape_check(ctx, env, call, cname, arg)
+            for kw in call.keywords:
+                if kw.arg in decl.static_names:
+                    if env.scalar_of(kw.value) is DYN:
+                        yield self.finding(
+                            ctx, call,
+                            f"a runtime-derived value (len() of runtime "
+                            f"data) reaches static argument "
+                            f"'{kw.arg}' of '{cname}' — every distinct "
+                            f"value compiles a new program; bucket it "
+                            f"first")
+                    continue
+                yield from self._shape_check(ctx, env, call, cname,
+                                             kw.value)
+
+    def _shape_check(self, ctx: FileContext, env, call: ast.Call,
+                     cname: str, arg: ast.expr) -> Iterator[Finding]:
+        shape = env.shape_of(arg)
+        if shape is not None and DYN in shape:
+            yield self.finding(
+                ctx, call,
+                f"an array whose shape carries a runtime-derived length "
+                f"reaches jit-compiled '{cname}' — the compile cache is "
+                f"keyed on argument shapes, so every distinct length "
+                f"compiles a new program; pad to a bucket first")
+
+
+# ---------------------------------------------------------------------------
+# JG020 — interprocedural donated-buffer liveness
+# ---------------------------------------------------------------------------
+
+def _self_attr_donors(ctx: FileContext,
+                      cls_node: ast.ClassDef) -> Dict[str, Tuple[int, ...]]:
+    """``self.X`` attributes of ``cls_node`` holding a donating wrapper:
+    assigned a direct jit-wrapper call with ``donate_argnums``, or the
+    result of a (cross-module) builder whose summary donates."""
+    donors: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(cls_node):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.value, ast.Call)):
+            continue
+        tgt = node.targets[0]
+        tname = dotted_name(tgt) if isinstance(tgt, ast.Attribute) else None
+        if tname is None or not tname.startswith("self."):
+            continue
+        callee = dotted_name(node.value.func) or ""
+        if callee in _JIT_WRAPPERS:
+            pos = _donated_positions(node.value)
+            if pos:
+                donors[tname] = pos
+        elif ctx.program is not None and ctx.module is not None:
+            resolved = ctx.program.summary_for_call(ctx.module, callee,
+                                                    cls_node.name)
+            if resolved is not None and resolved[1].donates:
+                donors[tname] = resolved[1].donates
+    return donors
+
+
+def _builder_donors(ctx: FileContext,
+                    fn: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    """Local names in ``fn`` bound from donating-builder calls (the
+    cross-module form JG007 cannot see; direct jit-wrapper assignments
+    are JG007's domain and are deliberately NOT collected here)."""
+    donors: Dict[str, Tuple[int, ...]] = {}
+    if ctx.program is None or ctx.module is None:
+        return donors
+    cls = ctx.jit_index.enclosing_class_name(fn)
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        callee = dotted_name(node.value.func) or ""
+        if callee in _JIT_WRAPPERS:
+            continue
+        resolved = ctx.program.summary_for_call(ctx.module, callee, cls)
+        if resolved is None or not resolved[1].donates:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                donors[tgt.id] = resolved[1].donates
+    return donors
+
+
+@register
+class InterprocDonationRule(Rule):
+    """``donate_argnums`` deletes the caller's buffer after the call —
+    JG007 catches reuse when the donating wrapper is a local name, but
+    the serving/training planes hold their donating step functions on
+    ``self`` and build them in other modules, where the donation is
+    invisible per-file. With ``FuncSummary.donates`` propagated through
+    the program index, a buffer passed at a donated position of a
+    wrapper held on ``self`` (or returned by a builder anywhere in the
+    program) and read again on any later path is a lint-time error.
+    """
+
+    code = "JG020"
+    summary = ("a buffer donated to a jitted callable (held on self or "
+               "built cross-module) is read again after the call")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        self._ctx = ctx
+        self._findings: List[Finding] = []
+        class_donors: Dict[ast.AST, Dict[str, Tuple[int, ...]]] = {}
+        for node in ctx.walk():
+            if isinstance(node, ast.ClassDef):
+                donors = _self_attr_donors(ctx, node)
+                if donors:
+                    class_donors[node] = donors
+        for fn in ctx.jit_index.functions:
+            donors = dict(_builder_donors(ctx, fn))
+            cur = ctx.jit_index.parent.get(fn)
+            while cur is not None:
+                if isinstance(cur, ast.ClassDef):
+                    donors.update(class_donors.get(cur, {}))
+                    break
+                cur = ctx.jit_index.parent.get(cur)
+            if donors:
+                self._walk(fn.body, donors, dead=set())
+        yield from self._findings
+
+    # -- JG007's dead-set walk, with dotted (self.X) donor names ---------
+    def _walk(self, stmts: Sequence[ast.stmt],
+              donors: Dict[str, Tuple[int, ...]], dead: Set[str]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, donors, dead)
+
+    def _stmt(self, stmt: ast.stmt, donors: Dict[str, Tuple[int, ...]],
+              dead: Set[str]) -> None:
+        if isinstance(stmt, (*_FUNC_TYPES, ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if stmt.value is not None:
+                self._expr(stmt.value, donors, dead)
+            if isinstance(stmt, ast.AugAssign) and \
+                    isinstance(stmt.target, ast.Name) and \
+                    stmt.target.id in dead:
+                self._report(stmt.target, donors, dead)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for tgt in targets:
+                self._revive(tgt, dead)
+            return
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, donors, dead)
+            d1, d2 = set(dead), set(dead)
+            self._walk(stmt.body, donors, d1)
+            self._walk(stmt.orelse, donors, d2)
+            dead.clear()
+            dead.update(d1 | d2)
+            return
+        if isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                self._expr(stmt.iter, donors, dead)
+                self._revive(stmt.target, dead)
+            else:
+                self._expr(stmt.test, donors, dead)
+            for _ in range(2):
+                d1 = set(dead)
+                self._walk(stmt.body, donors, d1)
+                dead.update(d1)
+            self._walk(stmt.orelse, donors, dead)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk(stmt.body, donors, dead)
+            for handler in stmt.handlers:
+                self._walk(handler.body, donors, dead)
+            self._walk(stmt.orelse, donors, dead)
+            self._walk(stmt.finalbody, donors, dead)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._expr(item.context_expr, donors, dead)
+            self._walk(stmt.body, donors, dead)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, donors, dead)
+
+    def _revive(self, target: ast.expr, dead: Set[str]) -> None:
+        if isinstance(target, ast.Name):
+            dead.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._revive(elt, dead)
+        elif isinstance(target, ast.Starred):
+            self._revive(target.value, dead)
+
+    def _expr(self, node: ast.expr, donors: Dict[str, Tuple[int, ...]],
+              dead: Set[str]) -> None:
+        if isinstance(node, (ast.Lambda, *_FUNC_TYPES)):
+            return
+        if isinstance(node, ast.Call) \
+                and (dotted_name(node.func) or "") in donors:
+            for arg in node.args:
+                self._expr(arg, donors, dead)
+            for kw in node.keywords:
+                self._expr(kw.value, donors, dead)
+            for pos in donors[dotted_name(node.func)]:
+                if pos < len(node.args) and \
+                        isinstance(node.args[pos], ast.Name):
+                    dead.add(node.args[pos].id)
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in dead:
+            self._report(node, donors, dead)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, donors, dead)
+
+    def _report(self, node: ast.Name,
+                donors: Dict[str, Tuple[int, ...]],
+                dead: Set[str]) -> None:
+        dead.discard(node.id)
+        self._findings.append(self.finding(
+            self._ctx, node,
+            f"'{node.id}' was donated to a jitted callable built "
+            f"elsewhere (donate_argnums on a self-held or builder-"
+            f"returned wrapper) and is read again — the buffer is "
+            f"deleted after the call; rebind it from the result"))

@@ -55,8 +55,10 @@ def _literal_axes(node: ast.expr) -> Optional[Tuple[str, ...]]:
     return None
 
 
-def _topology_axes(call: ast.Call) -> Optional[Tuple[str, ...]]:
-    """Axes of ``MeshTopology(data=2, ...)`` with literal int sizes."""
+def _topology_sizes(call: ast.Call) -> Optional[Tuple[Tuple[str, int], ...]]:
+    """``MeshTopology(data=2, ...)`` with literal int sizes ->
+    ``(("data", 2), ...)`` in canonical axis order (size-1 axes dropped;
+    the all-1 fallback is ``(("data", 1),)``, matching mesh.py)."""
     sizes: Dict[str, int] = {k: 1 for k in _TOPO_PARAMS}
     for i, arg in enumerate(call.args):
         if i >= len(_TOPO_PARAMS) or not (
@@ -72,8 +74,15 @@ def _topology_axes(call: ast.Call) -> Optional[Tuple[str, ...]]:
                 and isinstance(kw.value.value, int)):
             return None
         sizes[kw.arg] = kw.value.value
-    axes = tuple(_TOPO_AXIS[k] for k in _TOPO_CANON if sizes[k] > 1)
-    return axes or ("data",)
+    out = tuple((_TOPO_AXIS[k], sizes[k]) for k in _TOPO_CANON
+                if sizes[k] > 1)
+    return out or (("data", 1),)
+
+
+def _topology_axes(call: ast.Call) -> Optional[Tuple[str, ...]]:
+    """Axes of ``MeshTopology(data=2, ...)`` with literal int sizes."""
+    sized = _topology_sizes(call)
+    return tuple(a for a, _ in sized) if sized is not None else None
 
 
 class _MeshResolver:
@@ -138,6 +147,67 @@ class _MeshResolver:
                 expr.id, at, depth,
                 lambda value, site: self._topology_of(value, site,
                                                       depth + 1))
+        return None
+
+    def sizes_of(self, expr: ast.expr, at: ast.AST, depth: int = 0
+                 ) -> Optional[Tuple[Tuple[str, int], ...]]:
+        """Axis SIZES of the mesh ``expr`` evaluates to, as sorted-order
+        ``((axis, size), ...)`` pairs — the divisibility rule (JG018)
+        needs sizes where JG010/JG012 only need names. Resolvable for
+        ``MeshTopology(...)``/``.build()`` with literal sizes and for
+        ``Mesh(devs.reshape(a, b), ("x", "y"))`` with literal reshape
+        dims; ``data_parallel()`` (device-count-dependent) is not."""
+        if depth > 4:
+            return None
+        if isinstance(expr, ast.Call):
+            # chained ``MeshTopology(...).build()`` has no dotted name
+            # (the attribute chain roots at a Call, not a Name)
+            if isinstance(expr.func, ast.Attribute) \
+                    and expr.func.attr == "build":
+                return self._topology_sizes_of(expr.func.value, at, depth)
+            callee = dotted_name(expr.func) or ""
+            last = callee.rsplit(".", 1)[-1]
+            if last == "Mesh":
+                axes_arg = expr.args[1] if len(expr.args) >= 2 else None
+                dev_arg = expr.args[0] if expr.args else None
+                for kw in expr.keywords:
+                    if kw.arg == "axis_names":
+                        axes_arg = kw.value
+                    elif kw.arg == "devices":
+                        dev_arg = kw.value
+                axes = _literal_axes(axes_arg) if axes_arg is not None \
+                    else None
+                if axes is None or not isinstance(dev_arg, ast.Call) \
+                        or not isinstance(dev_arg.func, ast.Attribute) \
+                        or dev_arg.func.attr != "reshape":
+                    return None
+                dims = [a.value for a in dev_arg.args
+                        if isinstance(a, ast.Constant)
+                        and isinstance(a.value, int)]
+                if len(dims) != len(dev_arg.args) or len(dims) != len(axes):
+                    return None
+                return tuple(zip(axes, dims))
+            return None
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(
+                expr.id, at, depth,
+                lambda value, site: self.sizes_of(value, site, depth + 1))
+        return None
+
+    def _topology_sizes_of(self, expr: ast.expr, at: ast.AST, depth: int
+                           ) -> Optional[Tuple[Tuple[str, int], ...]]:
+        if depth > 4:
+            return None
+        if isinstance(expr, ast.Call):
+            callee = dotted_name(expr.func) or ""
+            if callee.rsplit(".", 1)[-1] == "MeshTopology":
+                return _topology_sizes(expr)
+            return None
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(
+                expr.id, at, depth,
+                lambda value, site: self._topology_sizes_of(value, site,
+                                                            depth + 1))
         return None
 
     def _resolve_name(self, name: str, at: ast.AST, depth: int,

@@ -196,7 +196,7 @@ class DeviceCachedDataSet(AbstractDataSet[MiniBatch]):
         data-parallel batch sharding."""
         if self._gather_fn is None:
             import jax
-            from jax import shard_map
+            from bigdl_tpu.utils.jax_compat import shard_map
             from jax.sharding import PartitionSpec as P
             ax = self._data_axis
 

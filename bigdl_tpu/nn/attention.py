@@ -26,6 +26,7 @@ import numpy as np
 from bigdl_tpu.nn import initialization as init
 from bigdl_tpu.nn.module import Module, TensorModule
 from bigdl_tpu.ops.precision import match_compute
+from bigdl_tpu.utils.jax_compat import axis_size
 
 
 class LayerNorm(TensorModule):
@@ -555,7 +556,7 @@ class MultiHeadAttention(Module):
                 if self.seq_layout == "zigzag":
                     from bigdl_tpu.parallel.context import _zigzag_positions
                     pos = _zigzag_positions(
-                        idx, q.shape[1], jax.lax.axis_size(self.seq_axis))
+                        idx, q.shape[1], axis_size(self.seq_axis))
                 else:
                     pos = idx * q.shape[1] + pos
             theta = getattr(self, "rope_theta", 10000.0)

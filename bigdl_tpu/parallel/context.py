@@ -48,6 +48,7 @@ from jax import lax
 
 from bigdl_tpu.ops.attention_core import (
     attention_partial, finalize_partial, online_softmax_combine)
+from bigdl_tpu.utils.jax_compat import axis_size, pcast
 
 _NEG = float(jnp.finfo(jnp.float32).min)
 
@@ -185,7 +186,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # the A/B lever must push the ring back to the XLA partial path.
         use_kernel = (jax.default_backend() == "tpu"
                       and not os.environ.get("BIGDL_TPU_FLASH_XLA_BWD"))
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     chunk = q.shape[1]
 
@@ -241,7 +242,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     rmax = jnp.full((b, n, s_loc), _NEG, jnp.float32)
     # Mark the zero-init carries as device-varying over the ring axis —
     # required by shard_map's vma typing (the loop outputs vary over 'seq').
-    acc, rsum, rmax = (lax.pcast(x, (axis_name,), to="varying")
+    acc, rsum, rmax = (pcast(x, (axis_name,), to="varying")
                        for x in (acc, rsum, rmax))
     acc, rsum, rmax, _, _ = lax.fori_loop(
         0, p, hop, (acc, rsum, rmax, k, v))
@@ -258,7 +259,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     num_heads % axis_size == 0.
     """
     from bigdl_tpu.ops.attention_core import blockwise_attention
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     n = q.shape[2]
     assert n % p == 0, f"heads {n} must divide seq axis size {p}"
 
@@ -278,7 +279,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def _wrap_shard_map(fn, mesh, axis_name):
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from bigdl_tpu.utils.jax_compat import shard_map
     spec = P(None, axis_name, None, None)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)

@@ -77,11 +77,19 @@ class DistriOptimizer(LocalOptimizer):
         self.topology = topology or MeshTopology.data_parallel()
         self.sync_mode = sync_mode
         self.compress_gradients = compress_gradients
-        if sync_mode in ("sharded", "fsdp") and topology and any(
+        if topology and any(
                 topology.sizes.get(ax, 1) > 1 for ax in ("tensor", "expert")):
-            raise ValueError(f"sync_mode={sync_mode!r} is a data-axis "
-                             "layout; combine tensor/expert parallelism "
-                             "with sync_mode='allreduce'")
+            # fsdp composes with tensor parallelism (weight shards carry
+            # both axes); the ZeRO-1 flat vector and expert stacking are
+            # data-axis-only layouts
+            if sync_mode == "sharded" or (
+                    sync_mode == "fsdp"
+                    and topology.sizes.get("expert", 1) > 1):
+                raise ValueError(f"sync_mode={sync_mode!r} does not "
+                                 "compose with this topology; combine "
+                                 "expert parallelism with "
+                                 "sync_mode='allreduce' (fsdp x tensor "
+                                 "is supported)")
         self.mesh: Mesh = self.topology.build()
         self._n_data = self.mesh.shape.get(DATA_AXIS, 1)
         self._n_tensor = self.mesh.shape.get(TENSOR_AXIS, 1)
@@ -224,7 +232,9 @@ class DistriOptimizer(LocalOptimizer):
         if self.sync_mode == "fsdp":
             from bigdl_tpu.parallel.fsdp import fsdp_param_specs, named_tree
             from bigdl_tpu.parallel.tensor_parallel import opt_state_specs
-            p_specs = fsdp_param_specs(params_tpl, self._n_data)
+            p_specs = fsdp_param_specs(
+                params_tpl, self._n_data,
+                base_specs=self._tp_base_specs(self.model))
             p_sh = named_tree(self.mesh, p_specs)
             s_sh = named_tree(self.mesh, opt_state_specs(
                 state_tpl, params_tpl, p_specs))
@@ -284,6 +294,14 @@ class DistriOptimizer(LocalOptimizer):
             m.to_result(num, int(cnt)) if cnt > 0 else None
             for m, (num, cnt) in zip(self.validation_methods, summed[:-1])]
         return merged, int(summed[-1][0])
+
+    def _tp_base_specs(self, model):
+        """Tensor-parallel base specs for the fsdp composition (fsdp x tp:
+        weight shards carry both mesh axes), or None on a pure data mesh."""
+        if self._n_tensor <= 1:
+            return None
+        from bigdl_tpu.parallel.tensor_parallel import infer_param_specs
+        return infer_param_specs(model, axis_size=dict(self.mesh.shape))
 
     # ------------------------------------------------------------------ step
     def _build_step(self) -> Callable:
@@ -364,7 +382,8 @@ class DistriOptimizer(LocalOptimizer):
         clip = make_grad_clipper(self._grad_clip)
 
         params0 = model.parameter_tree()
-        p_specs = fsdp_param_specs(params0, self._n_data)
+        p_specs = fsdp_param_specs(params0, self._n_data,
+                                   base_specs=self._tp_base_specs(model))
         state_tpl = jax.eval_shape(optim.init_state, params0)
         s_specs = opt_state_specs(state_tpl, params0, p_specs)
         p_sh = named_tree(self.mesh, p_specs)
@@ -397,7 +416,7 @@ class DistriOptimizer(LocalOptimizer):
 
     def _build_sharded_step(self) -> Callable:
         from jax.flatten_util import ravel_pytree
-        from jax import shard_map
+        from bigdl_tpu.utils.jax_compat import shard_map
 
         model, criterion, optim = self.model, self.criterion, self.optim_method
         reg_pairs = _regularizer_pairs(model)

@@ -37,7 +37,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from bigdl_tpu.parallel.mesh import DATA_AXIS
 
 
-def fsdp_param_specs(params: Any, n_dev: int, axis: str = DATA_AXIS) -> Any:
+def fsdp_param_specs(params: Any, n_dev: int, axis: str = DATA_AXIS,
+                     base_specs: Any = None) -> Any:
     """PartitionSpec tree matching ``params``: each leaf sharded on its
     canonical OUTPUT-feature dimension — dim 0 for 1-2D leaves (Linear is
     ``(out, in)``, biases ``(out,)``), the last dim for >=3D (conv HWIO's
@@ -49,18 +50,42 @@ def fsdp_param_specs(params: Any, n_dev: int, axis: str = DATA_AXIS) -> Any:
     GSPMD's involuntary-full-rematerialization path (observed on LeNet's
     conv->fc flatten). Contracting over the output dim instead leaves
     dx replicated-in-features, so activations keep their batch sharding
-    both ways."""
+    both ways.
 
-    def spec(leaf):
+    ``base_specs`` (fsdp x tp composition): a spec tree from
+    ``infer_param_specs`` whose tensor-axis entries are kept; ``axis``
+    lands on a dim the base spec leaves free — the canonical output dim
+    when it is free and divisible, else the first free divisible dim.
+    A leaf with no free divisible dim keeps just its base sharding."""
+
+    def spec(leaf, base=None):
         shape = np.shape(leaf)
-        if not shape:
+        if base is None:
+            if not shape:
+                return P()
+            d = 0 if len(shape) <= 2 else len(shape) - 1
+            if shape[d] >= n_dev and shape[d] % n_dev == 0:
+                return P(*([None] * d + [axis]))
             return P()
-        d = 0 if len(shape) <= 2 else len(shape) - 1
-        if shape[d] >= n_dev and shape[d] % n_dev == 0:
-            return P(*([None] * d + [axis]))
-        return P()
+        if not shape:
+            return base
+        entries = list(base) + [None] * (len(shape) - len(base))
+        canonical = 0 if len(shape) <= 2 else len(shape) - 1
+        for d in [canonical] + [i for i in range(len(shape))
+                                if i != canonical]:
+            if (entries[d] is None and shape[d] >= n_dev
+                    and shape[d] % n_dev == 0):
+                entries[d] = axis
+                return P(*entries)
+        return base
 
-    return jax.tree_util.tree_map(spec, params)
+    if base_specs is None:
+        return jax.tree_util.tree_map(spec, params)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    base_leaves = jax.tree_util.tree_leaves(
+        base_specs, is_leaf=lambda x: isinstance(x, P))
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec(l, b) for l, b in zip(leaves, base_leaves)])
 
 
 def shard_fraction(params: Any, n_dev: int) -> float:

@@ -48,6 +48,7 @@ from jax import lax
 
 from bigdl_tpu.nn.module import Module, functional_apply
 from bigdl_tpu.parallel.mesh import PIPELINE_AXIS
+from bigdl_tpu.utils.jax_compat import axis_size, pcast
 
 
 class PipelineStack(Module):
@@ -171,7 +172,7 @@ def gpipe_apply(stack: PipelineStack, local_params, x,
     The time loop is a ``lax.scan``: one compiled step body regardless of
     ``n_micro`` (compile time flat in microbatch count).
     """
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b = x.shape[0]
     assert b % n_micro == 0, f"batch {b} must divide into {n_micro} microbatches"
@@ -188,8 +189,8 @@ def gpipe_apply(stack: PipelineStack, local_params, x,
         stage_fn = jax.checkpoint(stage_fn)
 
     perm = [(i, (i + 1) % p) for i in range(p)]
-    state0 = lax.pcast(jnp.zeros_like(mbs[0]), (axis_name,), to="varying")
-    out_buf0 = lax.pcast(jnp.zeros_like(mbs), (axis_name,), to="varying")
+    state0 = pcast(jnp.zeros_like(mbs[0]), (axis_name,), to="varying")
+    out_buf0 = pcast(jnp.zeros_like(mbs), (axis_name,), to="varying")
     is_first = (idx == 0)
     is_last = (idx == p - 1)
 
@@ -240,7 +241,7 @@ def circular_apply(stack: PipelineStack, local_params, x, n_micro: int,
     """
     assert not stack.has_buffers, \
         "circular schedule supports buffer-free stacks only"
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     v = interleave
     b = x.shape[0]
@@ -264,11 +265,11 @@ def circular_apply(stack: PipelineStack, local_params, x, n_micro: int,
 
     perm = [(i, (i + 1) % p) for i in range(p)]
     delay = m - p  # steps a wrapped activation waits before stage 0 reuses it
-    state0 = lax.pcast(jnp.zeros_like(mbs[0]), (axis_name,), to="varying")
-    fifo0 = lax.pcast(
+    state0 = pcast(jnp.zeros_like(mbs[0]), (axis_name,), to="varying")
+    fifo0 = pcast(
         jnp.zeros((delay + 1,) + mbs.shape[1:], mbs.dtype),
         (axis_name,), to="varying")
-    out_buf0 = lax.pcast(jnp.zeros_like(mbs), (axis_name,), to="varying")
+    out_buf0 = pcast(jnp.zeros_like(mbs), (axis_name,), to="varying")
     is_first = (idx == 0)
     is_last = (idx == p - 1)
 
@@ -324,7 +325,7 @@ def gpipe_loss_fn(stack: PipelineStack, criterion, mesh,
     ``jax.grad`` yields dp-averaged gradients exactly like
     DistriOptimizer's allreduce plane.
     """
-    from jax import shard_map
+    from bigdl_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     p_specs = pipeline_spec_tree(stack, axis_name)
@@ -518,7 +519,7 @@ class StagePipeline:
         march stage-to-stage via ``lax.ppermute`` in the flat conduit, and
         the last stage's outputs are psum-replicated (transpose: the
         output cotangent re-enters the backward ring at the last stage)."""
-        p = lax.axis_size(axis_name)
+        p = axis_size(axis_name)
         assert p == len(self.stages), (
             f"mesh '{axis_name}' axis ({p}) must equal the stage count "
             f"({len(self.stages)})")
@@ -541,9 +542,9 @@ class StagePipeline:
             return lax.switch(idx, branches, flat_params[0], conduit)
 
         perm = [(i, (i + 1) % p) for i in range(p)]
-        state0 = lax.pcast(jnp.zeros((self.conduit_len,), jnp.float32),
+        state0 = pcast(jnp.zeros((self.conduit_len,), jnp.float32),
                            (axis_name,), to="varying")
-        out_buf0 = lax.pcast(
+        out_buf0 = pcast(
             jnp.zeros((n_micro, out_len), jnp.float32),
             (axis_name,), to="varying")
         is_first = (idx == 0)
@@ -583,7 +584,7 @@ def stage_pipeline_loss_fn(pipe: StagePipeline, criterion, mesh,
     ``pipe.parameter_tree()`` placed with ``pipe.spec()`` so each device
     holds only its stage's weights. ``data_axis`` composes dp x pp the
     same way (independent pipelines per data group, pmean'd loss)."""
-    from jax import shard_map
+    from bigdl_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     x_spec = P(data_axis) if data_axis else P()

@@ -1,0 +1,35 @@
+"""Version-tolerant jax imports.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace; the toolchains this repo targets span both
+sides of the move. Import it from here so every call site works on
+either.
+"""
+
+try:
+    from jax import shard_map  # noqa: F401
+except ImportError:  # pre-graduation toolchains (< jax 0.6)
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, **kwargs):
+        # the replication check was renamed check_rep -> check_vma at
+        # graduation; call sites use the new spelling
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(f, **kwargs)
+
+try:
+    from jax.lax import axis_size  # noqa: F401
+except ImportError:  # pre-graduation: axis_frame(name) IS the static size
+    from jax import core as _core
+
+    def axis_size(axis_name):
+        return _core.axis_frame(axis_name)
+
+try:
+    from jax.lax import pcast  # noqa: F401
+except ImportError:
+    def pcast(x, axes=None, *, to=None):
+        # varying/invariant marks exist only under the new vma typing;
+        # the old shard_map (check_rep) has nothing to mark
+        return x

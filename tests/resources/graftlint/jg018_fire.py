@@ -1,0 +1,30 @@
+"""JG018 positive: a statically known dim the mesh axis size cannot
+evenly divide.
+
+The mesh has data=8 but the batch dim is 12 (shard_map site) / 20
+(NamedSharding device_put site) — GSPMD pads every shard silently and
+the padding rides every collective.
+"""
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.parallel.mesh import MeshTopology
+
+
+def padded_reduce():
+    mesh = MeshTopology(data=8).build()
+    x = jnp.zeros((12, 16))                       # 12 % 8 != 0
+
+    def f(a):
+        return jax.lax.psum(a, "data")
+
+    fn = shard_map(f, mesh=mesh, in_specs=(P("data"),), out_specs=P())
+    return fn(x)
+
+
+def padded_placement():
+    mesh = MeshTopology(data=8).build()
+    x = jnp.ones((20, 4))                         # 20 % 8 != 0
+    return jax.device_put(x, NamedSharding(mesh, P("data")))

@@ -1,0 +1,42 @@
+"""JG018 near-misses: divisible dims, runtime-dependent dims, and an
+unresolvable mesh.
+
+Every site here is one the divisibility rule must stay silent on: the
+16-row batch divides data=8 exactly; a shape built from ``len()`` of
+runtime data is not statically known; and a mesh arriving as a
+parameter cannot be resolved, so the site is skipped rather than
+guessed at.
+"""
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.parallel.mesh import MeshTopology
+
+
+def exact_reduce():
+    mesh = MeshTopology(data=8).build()
+    x = jnp.zeros((16, 16))                       # 16 % 8 == 0
+
+    def f(a):
+        return jax.lax.psum(a, "data")
+
+    fn = shard_map(f, mesh=mesh, in_specs=(P("data"),), out_specs=P())
+    return fn(x)
+
+
+def runtime_batch(requests):
+    mesh = MeshTopology(data=8).build()
+    x = jnp.zeros((len(requests), 16))            # dim is runtime data
+
+    def f(a):
+        return jax.lax.psum(a, "data")
+
+    fn = shard_map(f, mesh=mesh, in_specs=(P("data"),), out_specs=P())
+    return fn(x)
+
+
+def foreign_mesh(mesh):
+    x = jnp.ones((20, 4))                         # mesh is a parameter:
+    return jax.device_put(x, NamedSharding(mesh, P("data")))

@@ -1,0 +1,23 @@
+"""JG019 positive: a runtime-derived length reaches the jit compile
+cache from a serving loop — once through a ``static_argnums`` position
+and once through an argument's SHAPE (the PR-15 per-prompt-length
+compile storm, detected statically).
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def prefill(tokens):
+    return tokens * 2
+
+
+def serve(requests):
+    crop = jax.jit(lambda a, n: a[:n], static_argnums=(1,))
+    out = []
+    for req in requests:
+        n = len(req.ids)
+        out.append(crop(jnp.zeros((128,)), n))    # static storm
+        x = jnp.zeros((len(req.ids), 16))
+        out.append(prefill(x))                    # shape-keyed storm
+    return out

@@ -1,0 +1,39 @@
+"""JG019 near-misses: the bucketed and one-shot forms of the same
+calls.
+
+Bucketing launders the runtime length — ``pow2_bucket`` is an
+unmodeled call, so its result is no longer tracked as dynamic (this is
+exactly the PR-15 fix: a bounded number of distinct static values
+compiles a bounded number of programs). A call outside any loop cannot
+storm regardless.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def prefill(tokens):
+    return tokens * 2
+
+
+def pow2_bucket(n):
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def serve(requests):
+    crop = jax.jit(lambda a, n: a[:n], static_argnums=(1,))
+    out = []
+    for req in requests:
+        n = pow2_bucket(len(req.ids))             # bucketed: bounded
+        out.append(crop(jnp.zeros((128,)), n))
+        x = jnp.zeros((pow2_bucket(len(req.ids)), 16))
+        out.append(prefill(x))
+    return out
+
+
+def one_shot(req):
+    crop = jax.jit(lambda a, n: a[:n], static_argnums=(1,))
+    return crop(jnp.zeros((128,)), len(req.ids))  # not loop-reachable

@@ -1,0 +1,15 @@
+"""JG020 positive: a buffer donated through a wrapper held on ``self``
+is read after the call — in a DIFFERENT method from the one that built
+the wrapper, where JG007's local-name analysis cannot see the
+donation.
+"""
+import jax
+
+
+class Trainer:
+    def __init__(self, step_fn):
+        self._step = jax.jit(step_fn, donate_argnums=(0,))
+
+    def run(self, params, batch):
+        out = self._step(params, batch)
+        return out, params.block_until_ready()    # params was donated

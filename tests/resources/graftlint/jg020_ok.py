@@ -1,0 +1,22 @@
+"""JG020 near-misses: the rebind idiom and a non-donating self-held
+wrapper.
+
+Rebinding the donated name from the call's result is exactly the fix
+the rule recommends; a wrapper without ``donate_argnums`` deletes
+nothing, so later reads are fine.
+"""
+import jax
+
+
+class Trainer:
+    def __init__(self, step_fn, eval_fn):
+        self._step = jax.jit(step_fn, donate_argnums=(0,))
+        self._eval = jax.jit(eval_fn)
+
+    def run(self, params, batch):
+        params = self._step(params, batch)        # rebound: old ref gone
+        return params
+
+    def evaluate(self, params, batch):
+        loss = self._eval(params, batch)          # nothing donated
+        return loss, params.mean()

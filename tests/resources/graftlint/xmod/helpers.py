@@ -20,3 +20,9 @@ def noisy_norm(x):
 
 def draw(key, shape):
     return jax.random.normal(key, shape)
+
+
+def make_step(fn):
+    # the returned wrapper DONATES its first argument — invisible from
+    # the modules that call the builder
+    return jax.jit(fn, donate_argnums=(0,))

@@ -3,7 +3,8 @@ here — every hazard lives behind the import boundary."""
 import jax
 import jax.numpy as jnp
 
-from xmod.helpers import deep_to_host, draw, noisy_norm, to_host
+from xmod.helpers import (deep_to_host, draw, make_step, noisy_norm,
+                          to_host)
 
 
 @jax.jit
@@ -22,3 +23,9 @@ def sample_pair(key, shape):
     a = draw(key, shape)                # helper draws from the key...
     b = draw(key, shape)                # JG003: same key drawn again
     return a, b
+
+
+def train(params, batch):
+    update = make_step(lambda p, b: p - 0.1 * b)
+    new_params = update(params, batch)  # builder's wrapper donated params
+    return new_params, params           # JG020: donated buffer read again

@@ -161,6 +161,7 @@ class TestPerfHarness:
         with pytest.raises(SystemExit):
             perf.main(["--model", "alexnet9000"])
 
+    @pytest.mark.slow  # ~32s: full cp train loop on the 1-core CPU box
     def test_transformer_lm_train_and_context_parallel(self, tmp_path):
         from bigdl_tpu.apps import transformer
         ck = str(tmp_path / "ck")
@@ -187,6 +188,7 @@ class TestPerfHarness:
         transformer.train(["-b", "8", "--seqLen", "32", "-e", "1",
                            "--synthetic-size", "16", "--moeExperts", "4"])
 
+    @pytest.mark.slow  # ~19s: two cp train sessions + resume
     def test_transformer_context_parallel_resume(self, tmp_path):
         """--contextParallel now composes with --model/--state: the cp
         loop writes (model.N, state.N) pairs through the resilience
@@ -194,7 +196,7 @@ class TestPerfHarness:
         saved driver instead of raising (ISSUE: transformer.py:150)."""
         pytest.importorskip("jax").__version__
         try:
-            from jax import shard_map  # noqa: F401 — cp loop needs it
+            from bigdl_tpu.utils.jax_compat import shard_map  # noqa: F401 — cp loop
         except ImportError:
             pytest.skip("jax.shard_map unavailable on this toolchain")
         from bigdl_tpu.apps import transformer
@@ -282,6 +284,7 @@ class TestPerfHarness:
         with pytest.raises(SystemExit, match="not both"):
             transformer.generate_cmd(["--fromHF", "x", "--model", "y"])
 
+    @pytest.mark.slow  # shard_map compile; needed the compat shim to run
     def test_context_parallel_matches_sequential_loss(self):
         # PE offsets + pmean correctness: first-step loss of the seq-parallel
         # path must equal the plain path on the same weights and batch
@@ -314,7 +317,7 @@ class TestPerfHarness:
         want = float(crit.apply(out, targets))
 
         # seq-parallel loss via the app's own loop internals
-        from jax import shard_map
+        from bigdl_tpu.utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
         from bigdl_tpu.parallel.mesh import MeshTopology
         mesh = MeshTopology(sequence=8).build()
@@ -467,6 +470,7 @@ class TestLlamaBlockContextParallel:
     """--llamaBlock --contextParallel: the long-context rope training
     recipe is CLI-reachable end to end (round 5)."""
 
+    @pytest.mark.slow  # shard_map compile; needed the compat shim to run
     def test_train_ring_rope(self, capsys):
         from bigdl_tpu.apps import transformer
         transformer.train(["-b", "8", "--seqLen", "32", "--maxEpoch", "1",

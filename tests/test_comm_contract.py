@@ -64,7 +64,7 @@ def test_allreduce_step_compiles_to_all_reduce():
 def test_ring_attention_compiles_to_collective_permute():
     # ring attention's defining trait: K/V blocks ROTATE around the ring
     # (ppermute -> collective-permute), no all-gather of the full sequence
-    from jax import shard_map
+    from bigdl_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
     from bigdl_tpu.nn.module import functional_apply
     enc = nn.TransformerEncoder(1, 16, 2, 32, causal=True, seq_axis="seq")
@@ -130,6 +130,53 @@ def test_expert_parallel_step_routes_over_expert_axis(dispatch):
     assert iota_form or brace_form, \
         "no collective reduces over the expert-axis cosets: " + \
         str(sorted(set(re.findall(r"replica_groups=\S*", txt))))
+
+
+def test_fsdp_tp_composed_step_collectives():
+    """fsdp x tp (first composed dryrun mode, ROADMAP #3): every weight
+    shard carries BOTH mesh axes at rest — fsdp_param_specs composes the
+    data axis onto a dim the Megatron spec leaves free. Collective RECORD
+    (EP-test precedent): on this toolchain the composed step keeps the
+    per-layer weight all-gathers over the DATA-axis pairs (the ZeRO-3
+    signature) and the tp all-reduce over the tensor cosets; the grad
+    sync lowers as all-reduce-keep-shard rather than a literal
+    reduce-scatter at this scale, so the contract pinned here is that
+    collectives form peer groups over BOTH axes — a regression to a
+    single-axis layout (replicated weights or lost tp sync) fails."""
+    import re
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.normal(0, 1, (28, 28, 1)).astype("float32"),
+                      float(rng.integers(1, 11))) for _ in range(16)]
+    ds = DataSet.array(samples, distributed=True) >> SampleToBatch(16)
+    m = nn.Sequential()
+    m.add(nn.Reshape((49, 16)))
+    m.add(nn.TransformerEncoderLayer(16, 4, 32))
+    m.add(nn.Select(2, 1))
+    m.add(nn.Linear(16, 10)).add(nn.LogSoftMax())
+    opt = DistriOptimizer(m, ds, nn.ClassNLLCriterion(),
+                          topology=MeshTopology(data=2, tensor=4),
+                          sync_mode="fsdp")
+    opt.set_optim_method(SGD(learningrate=0.1))
+    step = opt._build_step()
+    params = m.parameter_tree()
+    buffers = m.buffer_tree()
+    opt_state = opt._init_opt_state(params)
+    params, buffers, opt_state = opt._place_state(params, buffers, opt_state)
+    txt = step.lower(params, buffers, opt_state, jax.random.key(0),
+                     jnp.zeros((16, 28, 28, 1)),
+                     jnp.ones((16,))).compile().as_text()
+    assert "all-reduce" in txt, "fsdp x tp lost the tp partial-product sync"
+    # data-axis weight gathers: the per-layer ZeRO-3 gathers, grouped over
+    # the data pairs {0,4}/{1,5}/... (iota form [4,2]<=[2,4]T(1,0))
+    gathers = " ".join(
+        sorted(set(re.findall(r"all-gather\S*\([^\n]*?(replica_groups=\S+)",
+                              txt))))
+    assert ("[4,2]<=[2,4]T(1,0)" in gathers or "{0,4}" in gathers), \
+        "no weight all-gather over the data-axis pairs: " + gathers
+    groups = " ".join(sorted(set(re.findall(r"replica_groups=\S+", txt))))
+    # tensor cosets {0..3}/{4..7} on the (data=2, tensor=4) mesh
+    assert ("[2,4]<=[8]" in groups or "{0,1,2,3},{4,5,6,7}" in groups), \
+        "no collective over the tensor-axis cosets: " + groups
 
 
 def test_dp_tp_sp_regions_no_involuntary_rematerialization(capfd):

@@ -11,6 +11,12 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+# Slow tier: ~55s of 8-device shard_map compiles on a 1-core CPU box.
+# The whole module needed the jax-compat shard_map shim to even import,
+# so it contributed zero tier-1 coverage before round 11; the cheap
+# tier-1 smoke for the ring path lives in tests/test_comm_contract.py.
+pytestmark = pytest.mark.slow
+
 from bigdl_tpu.ops import attention_core as ac
 from bigdl_tpu.parallel.context import ring_self_attention
 from bigdl_tpu.parallel.mesh import MeshTopology
@@ -73,7 +79,7 @@ def test_ring_jits_and_shards():
 def test_transformer_encoder_context_parallel():
     # Full transformer stack sharded over the seq axis inside shard_map
     # matches the single-device stack with identical weights.
-    from jax import shard_map
+    from bigdl_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
     from bigdl_tpu import nn
     from bigdl_tpu.nn.module import functional_apply
@@ -246,7 +252,7 @@ class TestRopeContextParallel:
         ("ring", "contiguous"), ("ring", "zigzag"),
         ("ulysses", "contiguous")])
     def test_forward_and_grad_match_unsharded(self, mode, layout):
-        from jax import shard_map as _sm
+        from bigdl_tpu.utils.jax_compat import shard_map as _sm
         from jax.sharding import PartitionSpec as P
         from bigdl_tpu.nn.module import functional_apply
         from bigdl_tpu.parallel.context import (zigzag_inverse,

@@ -218,3 +218,46 @@ def test_scoreboard_r03_shared_prefix_artifacts():
     for r in spec["rows"]:
         assert r["failed"] == 0
         assert 0.5 <= r["spec_accept_rate"] <= 1.0
+
+
+def test_launcher_lint_sarif_smoke(tmp_path):
+    """`bigdl-tpu.sh lint --sarif` must produce a well-formed SARIF
+    2.1.0 document through the launcher (the CI-annotation path), even
+    when the linted tree is clean."""
+    launcher = os.path.join(REPO, "scripts", "bigdl-tpu.sh")
+    target = os.path.join(REPO, "bigdl_tpu", "analysis", "sarif.py")
+    out = tmp_path / "lint.sarif"
+    r = subprocess.run(
+        [launcher, "lint", target, "--sarif", str(out)],
+        capture_output=True, timeout=120)
+    assert r.returncode in (0, 1), r.stderr.decode(errors="replace")
+    assert b"SARIF report written" in r.stderr
+    import json
+
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    assert any(rule["id"] == "JG020"
+               for rule in run["tool"]["driver"]["rules"])
+
+
+def test_comm_model_drift_gate():
+    """COMM_MODEL.json must match what the tree actually contains —
+    same contract as the telemetry catalogue gate: regenerate with
+    `bigdl-tpu.sh lint --comm-model COMM_MODEL.json` when collective
+    call sites or the op/mode algebra change."""
+    import json
+
+    from bigdl_tpu.analysis import commcost
+
+    pinned = json.load(open(os.path.join(REPO, "COMM_MODEL.json")))
+    built = json.loads(json.dumps(commcost.build_model(REPO)))
+    assert pinned["version"] == built["version"]
+    assert pinned["ops"] == built["ops"], \
+        "op algebra drifted — regenerate COMM_MODEL.json"
+    assert pinned["modes"] == built["modes"], \
+        "mode models drifted — regenerate COMM_MODEL.json"
+    assert pinned["sites"] == built["sites"], (
+        "collective call sites drifted — regenerate COMM_MODEL.json "
+        "(lint --comm-model COMM_MODEL.json)")
