@@ -537,6 +537,16 @@ def serve_cmd(argv) -> None:
     ap.add_argument("--prefixCacheMB", type=float, default=None,
                     help="--continuous: prefix-cache budget in MiB "
                     "(default 64, or BIGDL_PREFIX_CACHE_MB)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="--continuous: in-process serving replicas "
+                    "behind the fleet router (models.router.LMRouter); "
+                    "a replica dying or draining moves its requests to "
+                    "a peer instead of failing them")
+    ap.add_argument("--disaggregate", default=None, metavar="P:D",
+                    help="--continuous: prefill:decode replica split "
+                    "(e.g. 1:2) — admission prefill runs on dedicated "
+                    "prefill replicas and ships the serialized state "
+                    "partition to decode replicas; overrides --replicas")
     ap.add_argument("--tokenizer", default=None,
                     help="BPE tokenizer path: requests may then POST "
                     '{"text": ...} and responses include decoded text')
@@ -583,24 +593,61 @@ def serve_cmd(argv) -> None:
     if tok is not None and args.eosId is None:
         args.eosId = tok.eos_id
     if args.continuous:
+        import copy
+
         from bigdl_tpu.models.serving import ContinuousLMServer
+        from bigdl_tpu.resilience.chaos import from_env as chaos_from_env
+        from bigdl_tpu.resilience.serving_drill import parse_split
+        split = parse_split(args.disaggregate)
+        n_decode = split[1] if split else max(1, args.replicas)
+        n_prefill = split[0] if split else 0
+        if (n_decode + n_prefill > 1) and args.draft:
+            raise SystemExit("--draft does not compose with a multi-"
+                             "replica fleet (state handoff is "
+                             "incompatible with speculative serving)")
+        chaos = chaos_from_env()
         draft = file_io.load(args.draft) if args.draft else None
-        server = ContinuousLMServer(
-            model, slots=args.slots, max_len=args.maxLen,
-            decode_block=args.decodeBlock,
-            max_new_tokens=args.maxNewTokens,
-            temperature=args.temperature, top_k=args.topK,
-            top_p=args.topP, greedy=args.greedy,
-            eos_id=args.eosId, seed=args.seed,
-            prefill_mode=args.prefillMode,
-            prefill_chunk=args.prefillChunk,
-            draft=draft, spec_len=args.specLen,
-            prefix_cache=(None if args.prefixCache is None
-                          else args.prefixCache == "on"),
-            prefix_cache_mb=args.prefixCacheMB)
+
+        def mk_server(mdl, slots, chaos_inj):
+            return ContinuousLMServer(
+                mdl, slots=slots, max_len=args.maxLen,
+                decode_block=args.decodeBlock,
+                max_new_tokens=args.maxNewTokens,
+                temperature=args.temperature, top_k=args.topK,
+                top_p=args.topP, greedy=args.greedy,
+                eos_id=args.eosId, seed=args.seed,
+                prefill_mode=args.prefillMode,
+                prefill_chunk=args.prefillChunk,
+                draft=draft, spec_len=args.specLen,
+                prefix_cache=(None if args.prefixCache is None
+                              else args.prefixCache == "on"),
+                prefix_cache_mb=args.prefixCacheMB,
+                chaos=chaos_inj)
+
+        if n_decode + n_prefill == 1:
+            server = mk_server(model, args.slots, chaos)
+        else:
+            # each replica holds its own decode state, so each needs its
+            # own module instance; deepcopies keep the weights
+            # bit-identical across the fleet (the handoff contract)
+            from bigdl_tpu.models.router import LMRouter
+            models = [model] + [copy.deepcopy(model)
+                                for _ in range(n_decode + n_prefill - 1)]
+            decode = [mk_server(models[i], args.slots,
+                                chaos if i == 0 else None)
+                      for i in range(n_decode)]
+            prefill = [mk_server(models[n_decode + i], 1, None)
+                       for i in range(n_prefill)]
+            server = LMRouter(decode, prefill_replicas=prefill,
+                              chaos=chaos)
+            print(f"fleet: {n_decode} decode"
+                  + (f" + {n_prefill} prefill" if n_prefill else "")
+                  + " replicas behind the router", file=sys.stderr)
     elif args.draft or args.specLen or args.prefixCache:
         raise SystemExit("--draft/--specLen/--prefixCache require "
                          "--continuous")
+    elif args.replicas != 1 or args.disaggregate:
+        raise SystemExit("--replicas/--disaggregate require --continuous")
     else:
         server = LMServer(model, max_batch=args.maxBatch,
                           batch_timeout_ms=args.batchTimeoutMs,
@@ -609,6 +656,29 @@ def serve_cmd(argv) -> None:
                           top_p=args.topP, greedy=args.greedy,
                           eos_id=args.eosId, seed=args.seed)
     httpd = make_http_server(server, args.host, args.port, tokenizer=tok)
+
+    # graceful drain: SIGTERM flips the PreemptionHandler flag; the
+    # watcher drains the server/fleet (in-flight requests leave as
+    # handoff cursors, /health turns 503 draining) and stops the HTTP
+    # loop — the preemption path for a serving process
+    import threading as _threading
+    import time
+
+    from bigdl_tpu.resilience.preemption import PreemptionHandler
+    preempt = PreemptionHandler().install()
+
+    def _watch_preemption():
+        while not preempt.should_snapshot():
+            time.sleep(0.1)
+        reason = preempt.reason or "preemption notice"
+        print(f"draining: {reason}", file=sys.stderr)
+        drain = getattr(server, "drain", None)
+        if drain is not None:
+            drain(reason)
+        httpd.shutdown()
+
+    _threading.Thread(target=_watch_preemption, daemon=True,
+                      name="bigdl-serve-preempt").start()
     print(f"serving on http://{args.host}:{httpd.server_address[1]} "
           f"(POST /generate, GET /health, GET /metrics)", file=sys.stderr)
     try:
@@ -618,6 +688,7 @@ def serve_cmd(argv) -> None:
     finally:
         httpd.shutdown()
         server.close()
+        preempt.uninstall()
 
 
 def main() -> None:

@@ -551,6 +551,45 @@ def partition_prefill_state(bufs):
     return state, statics, merge
 
 
+def serialize_prefill_state(lp, state) -> bytes:
+    """Pack one admission handoff — the (1, V) last-token log-probs plus
+    the b=1 state partition from ``partition_prefill_state`` — into a
+    single npz blob a peer replica can restore with
+    ``deserialize_prefill_state``.
+
+    This is the wire format of prefill/decode disaggregation (the router
+    ships it from a prefill replica to a decode replica) and of slot
+    migration off a draining server. Arrays are materialised host-side
+    in partition order (``s0..sN``), so restore rebuilds the exact list
+    the merge/insert machinery expects; bit-exactness holds because the
+    values are copied, never re-derived."""
+    import io
+
+    import numpy as np
+    buf = io.BytesIO()
+    arrs = {"lp": np.asarray(lp)}
+    for i, x in enumerate(state):
+        arrs[f"s{i}"] = np.asarray(x)
+    np.savez(buf, **arrs)
+    return buf.getvalue()
+
+
+def deserialize_prefill_state(data: bytes):
+    """Restore ``(lp, state)`` from a ``serialize_prefill_state`` blob.
+    The state list comes back in partition order, ready for
+    ``merge(state, statics)`` against the RECEIVER's shared buffers (the
+    statics are model weights — identical across replicas of the same
+    build, so only the per-request partition travels)."""
+    import io
+
+    import numpy as np
+    z = np.load(io.BytesIO(data))
+    lp = jnp.asarray(z["lp"])
+    n = sum(1 for k in z.files if k.startswith("s"))
+    state = [jnp.asarray(z[f"s{i}"]) for i in range(n)]
+    return lp, state
+
+
 def build_chunked_prefill_fns(model: Module, template_bufs, *,
                               site: str = "serving.prefill",
                               registry=None):

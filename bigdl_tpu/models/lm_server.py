@@ -303,9 +303,18 @@ def make_http_server(server: LMServer, host: str, port: int, tokenizer=None):
         -> {"ids": [...], "text": "..."?}
     GET  /health    -> {"ok": true, "batches_served": N, "queue_depth": N}
                        (503 + {"ok": false, "dead": reason} once a
-                       continuous server's worker loop has died)
+                       continuous server's worker loop has died; 503 +
+                       {"ok": false, "draining": reason} while it drains
+                       — distinct states, so a balancer can tell "retry
+                       elsewhere, shutting down cleanly" from "gone").
+                       A fleet router adds per-replica detail via its
+                       ``health_extra`` property.
     GET  /metrics   -> Prometheus text exposition (the server's registry;
                        docs/OBSERVABILITY.md has a scrape_config example)
+
+    ``server`` is anything speaking the submit()/queue_depth/
+    batches_served surface — a batcher, a continuous server, or a fleet
+    ``LMRouter`` (models/router.py) fronting N of them.
     """
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -336,13 +345,21 @@ def make_http_server(server: LMServer, host: str, port: int, tokenizer=None):
                 return self._reply(404,
                                    {"error": "GET /health or /metrics"})
             # a dead continuous server (worker-loop/decode failure) must
-            # flunk the probe so the orchestrator replaces the replica
+            # flunk the probe so the orchestrator replaces the replica;
+            # a DRAINING one flunks it too (stop sending traffic) but
+            # reports the distinct state — it is leaving on purpose and
+            # its in-flight work is being handed off, not lost
             dead = getattr(server, "dead_reason", None)
-            self._reply(503 if dead else 200,
-                        {"ok": dead is None,
+            draining = getattr(server, "drain_reason", None)
+            extra = getattr(server, "health_extra", None) or {}
+            self._reply(503 if (dead or draining) else 200,
+                        {"ok": dead is None and draining is None,
                          "batches_served": server.batches_served,
                          "queue_depth": server.queue_depth,
-                         **({"dead": dead} if dead else {})})
+                         **({"dead": dead} if dead else {}),
+                         **({"draining": draining}
+                            if (draining and not dead) else {}),
+                         **extra})
 
         def do_POST(self):
             if self.path != "/generate":

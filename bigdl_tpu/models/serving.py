@@ -89,7 +89,10 @@ from bigdl_tpu.models.generation import (_decode_modules,
                                          _shift_decode_pos,
                                          build_bucketed_prefill_fn,
                                          build_chunked_prefill_fns,
-                                         sample_token)
+                                         deserialize_prefill_state,
+                                         partition_prefill_state,
+                                         sample_token,
+                                         serialize_prefill_state)
 from bigdl_tpu.models.lm_server import drain_queue, fail_requests
 from bigdl_tpu.models.prefix_cache import (DEFAULT_PREFIX_CACHE_MB,
                                            prefix_cache_for)
@@ -110,6 +113,43 @@ _REQUEST_IDS = itertools.count(1)
 
 
 @dataclass
+class HandoffCursor:
+    """The migratable request cursor: everything a PEER replica needs to
+    finish an interrupted request with bit-identical greedy output —
+    re-prefilling ``ids + emitted`` reproduces the donor's exact chunked
+    reductions, so the continuation is the continuation the unkilled run
+    would have produced. Sampled (non-greedy) resumes are best-effort:
+    the admission key advances per admission, so a migrated draw comes
+    from a fresh stream."""
+    ids: List[int]                      # the original prompt
+    emitted: List[int]                  # tokens produced before the cut
+    max_new: int                        # the ORIGINAL token budget
+
+
+class ReplicaUnavailable(RuntimeError):
+    """``submit()`` failed because this replica cannot serve. ``cursor``
+    (when set) carries the accepted request's resume state — the caller
+    (the router) re-dispatches it to a peer; ``cursor=None`` means the
+    request never entered this replica and can simply be retried."""
+
+    def __init__(self, message: str, cursor: Optional[HandoffCursor] = None):
+        super().__init__(message)
+        self.cursor = cursor
+
+
+class ServerDraining(ReplicaUnavailable):
+    """Planned unavailability (SIGTERM/drain): the replica is finishing
+    or handing off its in-flight work — retry elsewhere, this process is
+    shutting down cleanly."""
+
+
+class ServerDead(ReplicaUnavailable):
+    """Unplanned unavailability (decode/worker failure): the donated
+    cache state is gone and the server will never serve again — retry
+    elsewhere against a healthy replica; this one needs a restart."""
+
+
+@dataclass
 class _Request:
     ids: List[int]
     max_new: int
@@ -118,6 +158,10 @@ class _Request:
     error: Optional[str] = None
     t_submit: float = 0.0               # perf_counter at submit (TTFT/SLO)
     rid: int = 0                        # trace-lifecycle id (serving.request)
+    emitted0: List[int] = field(default_factory=list)  # resume-cursor prefix
+    state_blob: Optional[bytes] = None  # shipped prefill partition (disagg)
+    handoff: Optional[HandoffCursor] = None
+    fail_kind: Optional[str] = None     # "draining" | "dead" | None
 
 
 class _Slot:
@@ -188,6 +232,10 @@ class _PrefillPipeline:
         self.model = model
         self.mhas, self.heads = mhas, heads
         self.mode, self.chunk, self.max_len = mode, chunk, max_len
+        # run() flips module-level trace flags and threads the template
+        # state — serialize it: the worker's admission prefill and a
+        # router thread's prefill_handoff() may hit the same pipeline
+        self._run_lock = threading.Lock()
         model.evaluate_mode()
         # single-request decode template (the prefill signature) FIRST,
         # then the persistent continuous state. The chunked template
@@ -345,16 +393,17 @@ class _PrefillPipeline:
         the flight recorder built during this prefill counts as serving
         recompile churn (per NEW SIGNATURE — a bucketed wrapper minting
         its second bucket counts exactly like a fresh program build)."""
-        fns = self.fns
-        before = sum(fn.compiles for fn in fns.values())
-        if self.mode == "bucketed":
-            with self.single_mode(prefilled=False, all_logits=True):
-                lp, small, hit = self._prefill_bucketed(ids)
-        else:
-            with self.single_mode(prefilled=True):
-                lp, small, hit = self._prefill_chunked(ids)
-        built = sum(fn.compiles for fn in fns.values()) - before
-        return lp, small, hit, built
+        with self._run_lock:
+            fns = self.fns
+            before = sum(fn.compiles for fn in fns.values())
+            if self.mode == "bucketed":
+                with self.single_mode(prefilled=False, all_logits=True):
+                    lp, small, hit = self._prefill_bucketed(ids)
+            else:
+                with self.single_mode(prefilled=True):
+                    lp, small, hit = self._prefill_chunked(ids)
+            built = sum(fn.compiles for fn in fns.values()) - before
+            return lp, small, hit, built
 
     def disable(self):
         for m in self.mhas + self.heads:
@@ -373,7 +422,8 @@ class ContinuousLMServer:
                  prefill_chunk: Optional[int] = None,
                  draft=None, spec_len: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
-                 prefix_cache_mb: Optional[float] = None):
+                 prefix_cache_mb: Optional[float] = None,
+                 chaos=None):
         if slots < 1:
             raise ValueError("slots must be >= 1")
         # prompt prefill strategy (both O(1)-compile; ROADMAP #1):
@@ -486,9 +536,23 @@ class ContinuousLMServer:
         self._spec_fn = None
         self._prefix_evictions_seen = 0
 
+        # serving-plane chaos injectors (resilience/chaos.py): anything
+        # with an on_decode_block(server) hook is polled at each block
+        # boundary INSIDE the decode try — a raising injector (the
+        # kill-replica drill) exercises the real die path mid-stream
+        self._chaos = [inj for inj in (chaos or [])
+                       if hasattr(inj, "on_decode_block")]
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._stop = threading.Event()
         self._dead: Optional[str] = None     # set once; never cleared
+        self._draining: Optional[str] = None  # set once; distinct from dead
+        # drain()/close() lifecycle arbitration: first caller wins the
+        # state transition, every later call is a harmless no-op sweep —
+        # close() stays idempotent under a concurrent drain
+        self._lifecycle_lock = threading.Lock()
+        # _prefix_evictions_seen read-modify-write happens on the worker
+        # (admission) AND router threads (prefill_handoff) — serialize it
+        self._prefix_sync_lock = threading.Lock()
         # slot bookkeeping is touched by the worker thread AND by
         # close()/client threads — every mutation of _free/_active holds
         # this lock (found by graftlint JG015: close() clearing _active
@@ -504,7 +568,16 @@ class ContinuousLMServer:
 
     # ------------------------------------------------------------ client API
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
-               timeout: Optional[float] = None) -> List[int]:
+               timeout: Optional[float] = None, *,
+               emitted: Optional[List[int]] = None,
+               state: Optional[bytes] = None) -> List[int]:
+        """Serve one prompt. ``emitted`` resumes a migrated request from
+        its ``HandoffCursor``: the server re-prefills ``prompt + emitted``
+        (deterministic, so the greedy continuation is bit-identical to
+        the donor's unkilled run) and the result INCLUDES the resumed
+        prefix. ``state`` admits a shipped prefill partition
+        (``serialize_prefill_state`` from a prefill replica) instead of
+        prefilling locally — the disaggregated decode path."""
         ids = [int(t) for t in prompt_ids]
         if not ids:
             raise ValueError("empty prompt")
@@ -515,11 +588,30 @@ class ContinuousLMServer:
         if len(ids) + max_new > self.max_len:
             raise ValueError(f"prompt {len(ids)} + max_new {max_new} "
                              f"exceeds the server max_len {self.max_len}")
+        emitted0 = [int(t) for t in (emitted or [])]
+        if emitted0:
+            # a cursor that already satisfied its budget (or hit eos)
+            # needs no decode at all — the donor just never got to
+            # deliver the result
+            if self.eos_id is not None and self.eos_id in emitted0:
+                return emitted0[:emitted0.index(self.eos_id) + 1][:max_new]
+            if len(emitted0) >= max_new:
+                return emitted0[:max_new]
+        if state is not None and self.draft is not None:
+            raise ValueError(
+                "state handoff is incompatible with speculative serving "
+                "(the draft replica's partition does not travel)")
         if self._dead is not None:
             # fail IMMEDIATELY: a dead worker loop will never drain the
             # queue, and waiting out the client timeout helps nobody
-            raise RuntimeError(f"server is dead: {self._dead}")
+            raise ServerDead(f"server is dead: {self._dead}")
+        if self._draining is not None:
+            # distinct from dead: the replica is going away ON PURPOSE —
+            # the caller should retry elsewhere, nothing is lost
+            raise ServerDraining(f"server is draining: {self._draining}")
         req = _Request(ids, max_new)
+        req.emitted0 = emitted0
+        req.state_blob = state
         req.rid = next(_REQUEST_IDS)
         req.t_submit = time.perf_counter()
         # request lifecycle: one async lane per rid in the Chrome trace —
@@ -528,16 +620,26 @@ class ContinuousLMServer:
         tracing.async_begin("serving.request", req.rid,
                             prompt_len=len(ids), max_new=max_new)
         self._queue.put(req)
-        if self._dead is not None and not req.done.is_set():
-            # the worker died between the check and the enqueue; its final
-            # drain may have missed this request — fail it here
-            req.error = f"server is dead: {self._dead}"
-            req.done.set()
-            tracing.async_end("serving.request", req.rid, error=req.error)
+        if not req.done.is_set() and (self._dead is not None
+                                      or self._draining is not None):
+            # the worker stopped between the check and the enqueue; its
+            # final sweep may have missed this request — fail it here
+            # (with a cursor, so a router can still re-dispatch it)
+            if self._dead is not None:
+                self._fail_handoff(req, emitted0,
+                                   f"server is dead: {self._dead}", "dead")
+            else:
+                self._fail_handoff(req, emitted0,
+                                   f"server is draining: {self._draining}",
+                                   "draining")
         self._tm.serving_queue_depth.set(self._queue.qsize())
         if not req.done.wait(timeout):
             raise TimeoutError("decode did not complete in time")
         if req.error is not None:
+            if req.fail_kind == "draining":
+                raise ServerDraining(req.error, cursor=req.handoff)
+            if req.fail_kind == "dead":
+                raise ServerDead(req.error, cursor=req.handoff)
             raise RuntimeError(req.error)
         return req.result
 
@@ -554,24 +656,91 @@ class ContinuousLMServer:
         recoverable in place."""
         return self._dead
 
+    @property
+    def drain_reason(self) -> Optional[str]:
+        """Why the server stopped ADMITTING (None unless draining).
+        Distinct from ``dead_reason``: a draining replica failed nothing
+        — every interrupted request left with a ``HandoffCursor`` and
+        ``/health`` reports ``draining`` so a router stops routing here
+        without declaring the replica lost."""
+        return self._draining
+
+    def drain(self, reason: str = "drain requested") -> None:
+        """Graceful shutdown (the SIGTERM path): stop admitting, stop
+        the decode loop at the next block boundary, and hand every
+        accepted-but-unfinished request off as a ``HandoffCursor``
+        (prompt ids + emitted tokens + budget) raised to its waiting
+        ``submit()`` as ``ServerDraining`` — a router re-dispatches the
+        cursor to a peer, whose deterministic re-prefill keeps greedy
+        outputs bit-identical to an unkilled run. Idempotent, and safe
+        to race with ``close()``: the first lifecycle call wins, later
+        ones only re-sweep (finding nothing)."""
+        with self._lifecycle_lock:
+            if self._dead is not None or self._draining is not None:
+                return
+            self._draining = reason
+        self._tm.serving_drains_total.inc()
+        self._stop.set()
+        self._worker.join(timeout=10)
+        self._sweep_stranded()
+
     def close(self):
+        """Stop the worker and fail anything still pending. Idempotent,
+        including under a CONCURRENT ``drain()``: both sides snapshot-
+        and-clear the slot table under ``_state_lock``, so each stranded
+        request is failed exactly once — and when the drain got there
+        first, with its handoff cursor intact (``_fail_handoff`` never
+        overwrites a request that already completed or failed)."""
         self._stop.set()
         self._worker.join(timeout=10)
         for p in self._pipelines:
             p.disable()
-        with self._state_lock:
-            stranded = list(self._active.values())
-            self._active.clear()
-        fail_requests([sl.req for sl in stranded],
-                      "server closed mid-generation",
-                      category="serving.request")
-        fail_requests(drain_queue(self._queue),
-                      "server closed before the request was dispatched",
-                      category="serving.request")
+        self._sweep_stranded()
+
+    def prefill_handoff(self, prompt_ids,
+                        emitted: Optional[List[int]] = None) -> bytes:
+        """Run the admission prefill WITHOUT taking a slot and return
+        the serialized handoff partition (last-token log-probs + b=1
+        state) for a DECODE replica's ``submit(..., state=blob)`` — the
+        prefill half of prefill/decode disaggregation. Raises
+        ``ServerDraining``/``ServerDead`` like ``submit`` so the router's
+        health logic applies unchanged."""
+        ids = ([int(t) for t in prompt_ids]
+               + [int(t) for t in (emitted or [])])
+        if not ids:
+            raise ValueError("empty prompt")
+        if self._dead is not None:
+            raise ServerDead(f"server is dead: {self._dead}")
+        if self._draining is not None:
+            raise ServerDraining(f"server is draining: {self._draining}")
+        if self._d_pipeline is not None:
+            raise ValueError("prefill handoff is incompatible with "
+                             "speculative serving (the draft partition "
+                             "does not travel)")
+        with span("serving.prefill", plen=len(ids), rid=0,
+                  mode=self.prefill_mode):
+            lp, small, hit, built = self._pipeline.run(ids)
+        if built:
+            self._tm.serving_recompiles_total.inc(built)
+        self._sync_prefix_metrics(hit)
+        state = partition_prefill_state(small)[0]
+        return serialize_prefill_state(lp, state)
 
     @property
     def batches_served(self) -> int:
         return self._n_served
+
+    @property
+    def requests_admitted(self) -> int:
+        """Requests admitted into slots over this server's lifetime —
+        the trigger the serving-plane chaos injectors key off."""
+        return self._n_admitted
+
+    @property
+    def decode_blocks(self) -> int:
+        """Decode blocks started (1-based inside the current block) —
+        the other chaos trigger."""
+        return self._steps
 
     # ------------------------------------------------------------- programs
     @property
@@ -624,11 +793,12 @@ class ContinuousLMServer:
             return
         (self._tm.prefix_cache_hits if hit
          else self._tm.prefix_cache_misses).inc()
-        ev = sum(pc.evictions for pc in caches)
-        if ev > self._prefix_evictions_seen:
-            self._tm.prefix_cache_evictions.inc(
-                ev - self._prefix_evictions_seen)
-            self._prefix_evictions_seen = ev
+        with self._prefix_sync_lock:
+            ev = sum(pc.evictions for pc in caches)
+            if ev > self._prefix_evictions_seen:
+                self._tm.prefix_cache_evictions.inc(
+                    ev - self._prefix_evictions_seen)
+                self._prefix_evictions_seen = ev
         self._tm.prefix_cache_bytes.set(sum(pc.nbytes for pc in caches))
 
     def _insert(self):
@@ -752,9 +922,32 @@ class ContinuousLMServer:
         return (np.asarray(emit), np.asarray(n_emit),
                 np.asarray(cur).astype(np.int32), bufs, d_bufs)
 
+    def _restore_handoff(self, blob: bytes):
+        """Admit a SHIPPED prefill partition (disaggregation's decode
+        half): deserialize, validate the leaf shapes against this
+        server's own template, and merge with the LOCAL statics — model
+        weights are identical across replicas of one build, so only the
+        per-request partition ever travels."""
+        lp, state = deserialize_prefill_state(blob)
+        pipe = self._pipeline
+        if len(state) != len(pipe.state0):
+            raise ValueError(
+                f"handoff partition has {len(state)} leaves; this "
+                f"server's prefill template has {len(pipe.state0)}")
+        for i, (got, want) in enumerate(zip(state, pipe.state0)):
+            if got.shape != want.shape:
+                raise ValueError(
+                    f"handoff leaf {i} has shape {got.shape}, template "
+                    f"expects {want.shape} (mismatched prefill mode/"
+                    f"chunk between prefill and decode replicas?)")
+        return lp, pipe.merge(state, pipe.statics)
+
     # --------------------------------------------------------------- worker
     def _admit(self, req: _Request) -> bool:
-        plen = len(req.ids)
+        # the CONTEXT the caches must hold: the prompt plus any resumed
+        # cursor prefix (a migrated request re-prefills both — that
+        # deterministic replay is what keeps greedy outputs bit-exact)
+        plen = len(req.ids) + len(req.emitted0)
         t_admit = time.perf_counter()
         # queue-wait attribution: the retrodicted submit->admission span
         # plus an instant on the request's async lane, both under its rid
@@ -763,7 +956,12 @@ class ContinuousLMServer:
         try:
             with span("serving.prefill", plen=plen, rid=req.rid,
                       mode=self.prefill_mode):
-                lp, small, d_small, hit = self._run_prefill(req.ids)
+                if req.state_blob is not None:
+                    lp, small = self._restore_handoff(req.state_blob)
+                    d_small, hit = None, 0
+                else:
+                    lp, small, d_small, hit = self._run_prefill(
+                        req.ids + req.emitted0)
                 # key advances per ADMISSION (not per completion — several
                 # admits can happen between completions, and identical
                 # prompts sampled under a reused key would correlate
@@ -806,8 +1004,8 @@ class ContinuousLMServer:
             self._tm.serving_admissions_total.inc()
             self._tm.serving_tokens_total.inc()
             sl = _Slot(req)
-            sl.emitted = [tok]
-            sl.new_count = 1
+            sl.emitted = list(req.emitted0) + [tok]
+            sl.new_count = len(req.emitted0) + 1
             self._last_tok[slot] = tok
             if self._finish_if_done(slot, sl):
                 return True
@@ -842,26 +1040,77 @@ class ContinuousLMServer:
             return True
         return False
 
+    def _fail_handoff(self, req: _Request, emitted: List[int],
+                      message: str, kind: str) -> None:
+        """Fail one request WITH its resume cursor: the host-side prompt
+        + emitted tokens survive any device-state loss, so even a dead
+        replica's accepted requests leave with everything a peer needs
+        to finish them bit-identically (greedy). Skips requests that
+        already completed or failed — a second sweeper must not
+        overwrite the first one's verdict (or a delivered result)."""
+        if req.done.is_set():
+            return
+        req.handoff = HandoffCursor(ids=list(req.ids),
+                                    emitted=list(emitted),
+                                    max_new=req.max_new)
+        req.fail_kind = kind
+        req.error = message
+        req.done.set()
+        tracing.async_end("serving.request", req.rid, error=message)
+
+    def _sweep_stranded(self) -> None:
+        """Snapshot-and-clear every in-flight slot and queued request,
+        then fail them — with handoff cursors when the server is
+        draining (migration), plain errors on an ordinary close. Shared
+        by ``close()``, ``drain()`` and the worker's stop-path (each
+        side may run it; the snapshot under ``_state_lock`` guarantees
+        every request is failed at most once)."""
+        with self._state_lock:
+            stranded = list(self._active.items())
+            self._active.clear()
+            self._free.extend(s for s, _ in stranded)
+        queued = drain_queue(self._queue)
+        draining = self._draining
+        if draining is not None:
+            msg = f"server draining: {draining}"
+            for _s, sl in stranded:
+                self._fail_handoff(sl.req, sl.emitted, msg, "draining")
+            for req in queued:
+                self._fail_handoff(req, req.emitted0, msg, "draining")
+        else:
+            fail_requests([sl.req for _s, sl in stranded],
+                          "server closed mid-generation",
+                          category="serving.request")
+            fail_requests(queued,
+                          "server closed before the request was dispatched",
+                          category="serving.request")
+        self._tm.serving_slots_occupied.set(0)
+        self._tm.serving_queue_depth.set(0)
+
     def _die(self, reason: str) -> None:
         """Dead-server state (ADVICE medium, ROADMAP #1): fail every
         in-flight AND queued request NOW, mark the server dead so later
         ``submit()`` calls raise immediately instead of queueing against a
         worker that will never serve them. Never cleared — a decode-step
         failure invalidates the donated cache buffers, so the only safe
-        continuation is a new server."""
+        continuation is a new server. Every failed request still leaves
+        with its ``HandoffCursor`` (the cursor is host-side state): a
+        router re-dispatches it to a healthy peer and the kill loses
+        zero accepted requests."""
         self._dead = reason
         self._tm.serving_request_errors_total.inc(len(self._active))
         with self._state_lock:
             stranded = list(self._active.items())
             self._active.clear()
             self._free.extend(slot for slot, _ in stranded)
-        fail_requests([sl.req for _s, sl in stranded],
-                      f"server died: {reason}",
-                      category="serving.request")
+        for _s, sl in stranded:
+            self._fail_handoff(sl.req, sl.emitted,
+                               f"server died: {reason}", "dead")
         self._tm.serving_slots_occupied.set(0)
         queued = drain_queue(self._queue)
-        fail_requests(queued, f"server is dead: {reason}",
-                      category="serving.request")
+        for req in queued:
+            self._fail_handoff(req, req.emitted0,
+                               f"server is dead: {reason}", "dead")
         self._tm.serving_request_errors_total.inc(len(queued))
         self._tm.serving_queue_depth.set(0)
 
@@ -875,21 +1124,12 @@ class ContinuousLMServer:
 
     def _run_loop(self):
         self._serve_loop()
-        # stop-path drain ON THE WORKER (mirrors close()): the client-
-        # side sweep runs after a BOUNDED join, so on a timed-out join
-        # this loop may have admitted or dequeued a request after it —
-        # fail the leftovers here so nobody waits out a client timeout,
-        # whichever side runs last
-        with self._state_lock:
-            stranded = list(self._active.items())
-            self._active.clear()
-            self._free.extend(s for s, _ in stranded)
-        fail_requests([sl.req for _s, sl in stranded],
-                      "server closed mid-generation",
-                      category="serving.request")
-        fail_requests(drain_queue(self._queue),
-                      "server closed before the request was dispatched",
-                      category="serving.request")
+        # stop-path sweep ON THE WORKER (mirrors close()/drain()): the
+        # client-side sweep runs after a BOUNDED join, so on a timed-out
+        # join this loop may have admitted or dequeued a request after
+        # it — fail the leftovers here so nobody waits out a client
+        # timeout, whichever side runs last
+        self._sweep_stranded()
 
     def _serve_loop(self):
         while not self._stop.is_set():
@@ -918,6 +1158,12 @@ class ContinuousLMServer:
             self._steps += 1
             counts = None           # spec mode: per-row emit counts
             try:
+                for inj in self._chaos:
+                    # serving-plane injectors: a raising hook (the
+                    # kill-replica drill) lands in the except below and
+                    # drives the REAL die path mid-stream; a sleeping
+                    # hook (delay-decode) stretches exactly one block
+                    inj.on_decode_block(self)
                 t_block = time.perf_counter()
                 with span("serving.decode_block",
                           live=len(self._active)) as sp:

@@ -11,6 +11,13 @@ Subcommands (all filesystem-only — no device/backend touch):
   delete] [--seed S]``: deterministically damage a shard file (drills
   the partial-snapshot rejection path).
 - ``chaos selftest``: exercise the injectors deterministically.
+- ``chaos drill [--replicas N] [--disaggregate P:D] [--requests R]``:
+  the kill-one-replica serving drill — build a tiny in-process fleet
+  behind ``LMRouter``, kill one decode replica mid-stream via
+  ``kill-replica@K``, and assert ZERO accepted requests lost with
+  greedy outputs bit-identical to an unkilled reference. The only
+  subcommand that touches jax; prints a JSON report, exit 0 iff the
+  drill holds.
 """
 
 from __future__ import annotations
@@ -80,9 +87,54 @@ def _cmd_chaos_selftest(args) -> int:
              ("kill@5", "kill@7:SIGINT", "delay@3:0.5")]
     assert [type(s).__name__ for s in specs] == ["KillAtStep", "KillAtStep",
                                                  "DelayAtStep"]
+
+    # serving-plane injectors against stub server/router objects
+    class _Stub:
+        requests_admitted = 0
+        decode_blocks = 0
+    stub = _Stub()
+    kr = chaos_mod.KillReplicaAfterRequests(2)
+    kr.on_decode_block(stub)          # 0 admitted: no fire
+    stub.requests_admitted = 2
+    try:
+        kr.on_decode_block(stub)
+        raise AssertionError("KillReplicaAfterRequests did not fire")
+    except chaos_mod.ChaosReplicaKill:
+        pass
+    kr.on_decode_block(stub)          # fires once only
+    slept2 = []
+    dd = chaos_mod.DelayDecodeStep(3, 0.125, _sleep=slept2.append)
+    for block in range(1, 6):
+        stub.decode_blocks = block
+        dd.on_decode_block(stub)
+    assert slept2 == [0.125], slept2
+    dh = chaos_mod.DropHandoff(2)
+    drops = [dh.on_handoff(None) for _ in range(4)]
+    assert drops == [False, True, False, False], drops
+    sspecs = [chaos_mod.parse_spec(s) for s in
+              ("kill-replica@2", "delay-decode@3:0.25", "drop-handoff@1")]
+    assert [type(s).__name__ for s in sspecs] == [
+        "KillReplicaAfterRequests", "DelayDecodeStep", "DropHandoff"]
     print("chaos selftest: kill-at-step fired once at 3; delay slept 0.25s "
-          "at 2; spec parsing ok")
+          "at 2; kill-replica raised once at 2 admissions; delay-decode "
+          "slept 0.125s at block 3; drop-handoff dropped exactly the 2nd; "
+          "spec parsing ok")
     return 0
+
+
+def _cmd_chaos_drill(args) -> int:
+    """Kill-one-replica fleet drill (see tests/test_serving_fleet.py for
+    the pinned version). Heavy: imports jax and compiles tiny models."""
+    import json
+
+    from bigdl_tpu.resilience.serving_drill import run_kill_drill
+
+    report = run_kill_drill(replicas=args.replicas,
+                            disaggregate=args.disaggregate,
+                            requests=args.requests,
+                            kill_after=args.kill_after)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0 if report["ok"] else 1
 
 
 def main(argv=None) -> int:
@@ -111,6 +163,15 @@ def main(argv=None) -> int:
     c.set_defaults(fn=_cmd_chaos_corrupt)
     c = csub.add_parser("selftest", help="deterministic injector check")
     c.set_defaults(fn=_cmd_chaos_selftest)
+    c = csub.add_parser("drill",
+                        help="kill-one-replica zero-loss serving drill")
+    c.add_argument("--replicas", type=int, default=2)
+    c.add_argument("--disaggregate", default=None, metavar="P:D",
+                   help="prefill:decode split, e.g. 1:2")
+    c.add_argument("--requests", type=int, default=6)
+    c.add_argument("--kill-after", type=int, default=2,
+                   help="kill replica 0 after it admits this many requests")
+    c.set_defaults(fn=_cmd_chaos_drill)
 
     args = parser.parse_args(argv)
     return args.fn(args)

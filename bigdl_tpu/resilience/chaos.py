@@ -15,6 +15,16 @@ so a recovery test failing once fails every time:
   seeded, truncate, or delete) against a sharded snapshot dir — what the
   partial-snapshot-rejection tests and ``scripts/bigdl-tpu.sh chaos
   corrupt`` feed the coordinator.
+- SERVING-PLANE injectors (the fleet drill, ``bigdl-tpu.sh chaos
+  drill``): ``KillReplicaAfterRequests`` (``kill-replica@N`` — the
+  attached continuous server dies at the first decode-block boundary
+  after admitting N requests, driving the REAL die path mid-stream),
+  ``DelayDecodeStep`` (``delay-decode@B:S`` — stall decode block B for
+  S seconds, the straggler-replica simulation), and ``DropHandoff``
+  (``drop-handoff@N`` — the router's Nth shipped prefill partition
+  evaporates in transit, exercising the re-ship fallback). Servers poll
+  anything with an ``on_decode_block(server)`` hook; the router polls
+  ``on_handoff(router)``.
 
 jax-free; importable by the CLI on a bare host.
 """
@@ -26,8 +36,16 @@ import signal
 import time
 from typing import List, Optional
 
-__all__ = ["KillAtStep", "DelayAtStep", "corrupt_snapshot", "parse_spec",
-           "from_env"]
+__all__ = ["KillAtStep", "DelayAtStep", "KillReplicaAfterRequests",
+           "DelayDecodeStep", "DropHandoff", "ChaosReplicaKill",
+           "corrupt_snapshot", "parse_spec", "from_env"]
+
+
+class ChaosReplicaKill(RuntimeError):
+    """Raised inside a serving worker's decode dispatch by
+    ``KillReplicaAfterRequests`` — lands in the server's die path
+    exactly like a real decode failure (donated buffers gone, requests
+    failed WITH their handoff cursors)."""
 
 
 class KillAtStep:
@@ -71,6 +89,76 @@ class DelayAtStep:
 
     def __repr__(self):
         return f"DelayAtStep(step={self.step}, seconds={self.seconds})"
+
+
+class KillReplicaAfterRequests:
+    """Kill the attached serving replica at the first decode-block
+    boundary after it has admitted ``n`` requests: raises
+    ``ChaosReplicaKill`` inside the worker's decode dispatch, driving
+    the REAL die path (donated buffers lost, in-flight requests failed
+    with their handoff cursors). The kill-one-replica drill's trigger."""
+
+    def __init__(self, n: int):
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        self.n = int(n)
+        self.fired = False
+
+    def on_decode_block(self, server) -> None:
+        if not self.fired and server.requests_admitted >= self.n:
+            self.fired = True
+            raise ChaosReplicaKill(
+                f"chaos: replica killed after {self.n} admissions")
+
+    def __repr__(self):
+        return f"KillReplicaAfterRequests(n={self.n})"
+
+
+class DelayDecodeStep:
+    """Stall one decode block for ``seconds`` (straggler-replica
+    simulation): sleeps inside the worker loop the first time block
+    ``block`` starts, delaying every stream on the replica by exactly
+    one injected pause."""
+
+    def __init__(self, block: int, seconds: float, _sleep=time.sleep):
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        self.block = int(block)
+        self.seconds = float(seconds)
+        self.fired = False
+        self._sleep = _sleep
+
+    def on_decode_block(self, server) -> None:
+        if not self.fired and server.decode_blocks >= self.block:
+            self.fired = True
+            self._sleep(self.seconds)
+
+    def __repr__(self):
+        return f"DelayDecodeStep(block={self.block}, seconds={self.seconds})"
+
+
+class DropHandoff:
+    """Evaporate the router's ``n``-th shipped prefill partition in
+    transit (``on_handoff`` returns True exactly once): exercises the
+    router's re-ship / local-prefill fallback in the disaggregated
+    topology."""
+
+    def __init__(self, n: int = 1):
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        self.n = int(n)
+        self.seen = 0
+        self.fired = False
+
+    def on_handoff(self, router) -> bool:
+        self.seen += 1
+        if not self.fired and self.seen >= self.n:
+            self.fired = True
+            return True
+        return False
+
+    def __repr__(self):
+        return f"DropHandoff(n={self.n})"
 
 
 def corrupt_snapshot(path: str, shard: int = 0, mode: str = "flip",
@@ -120,8 +208,9 @@ def corrupt_snapshot(path: str, shard: int = 0, mode: str = "flip",
 
 
 def parse_spec(spec: str):
-    """One injector from ``kind@step[:arg]``: ``kill@5``,
-    ``kill@7:SIGINT``, ``delay@3:0.25``."""
+    """One injector from ``kind@step[:arg]``. Training-plane:
+    ``kill@5``, ``kill@7:SIGINT``, ``delay@3:0.25``. Serving-plane:
+    ``kill-replica@2``, ``delay-decode@3:0.25``, ``drop-handoff@1``."""
     kind, _, rest = spec.strip().partition("@")
     step_s, _, arg = rest.partition(":")
     try:
@@ -137,6 +226,12 @@ def parse_spec(spec: str):
         return KillAtStep(step, sig)
     if kind == "delay":
         return DelayAtStep(step, float(arg or "1.0"))
+    if kind == "kill-replica":
+        return KillReplicaAfterRequests(step)
+    if kind == "delay-decode":
+        return DelayDecodeStep(step, float(arg or "1.0"))
+    if kind == "drop-handoff":
+        return DropHandoff(step)
     raise ValueError(f"unknown chaos injector {kind!r} in {spec!r}")
 
 

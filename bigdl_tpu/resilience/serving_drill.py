@@ -1,0 +1,134 @@
+"""The kill-one-replica serving drill (``bigdl-tpu.sh chaos drill``).
+
+An executable statement of the fleet's zero-loss contract: build a tiny
+in-process fleet behind ``LMRouter``, attach a ``KillReplicaAfterRequests``
+injector to decode replica 0 so it dies mid-stream through the REAL die
+path, drive a batch of concurrent greedy requests — and assert that
+every request completes with output bit-identical to an unkilled
+single-server reference. The pinned (fast, deterministic) version lives
+in ``tests/test_serving_fleet.py``; this module is the CLI-sized knob
+(``--replicas``, ``--disaggregate P:D``, ``--requests``) for poking the
+drill at other fleet shapes.
+
+Heavy: imports jax and compiles the tiny models. Everything else in
+``resilience/`` stays jax-free; keep drill-only imports inside here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["run_kill_drill"]
+
+VOCAB = 24
+
+
+def _mk_model(seed: int = 4):
+    """The test-sized LM every replica shares (identical weights by
+    construction — one build's replicas must agree bit-for-bit)."""
+    from bigdl_tpu.models import transformer
+    from bigdl_tpu.utils.rng import manual_seed
+
+    manual_seed(seed)
+    return transformer.build_lm(VOCAB, 16, 2, 32, num_layers=2, max_len=64,
+                                rope=True, activation="swiglu", norm="rms",
+                                tie_embeddings=True)
+
+
+def _reference(ids, max_new):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.models.generation import generate
+
+    out = np.asarray(generate(_mk_model(), jnp.asarray(
+        np.asarray(ids, np.float32)[None]), max_new, greedy=True))
+    return out[0, len(ids):].astype(int).tolist()
+
+
+def parse_split(spec: Optional[str]):
+    """``'P:D'`` -> (prefill, decode) counts, or None for aggregated."""
+    if not spec:
+        return None
+    p_s, sep, d_s = spec.partition(":")
+    try:
+        p, d = int(p_s), int(d_s)
+    except ValueError:
+        raise ValueError(f"bad disaggregate spec {spec!r}: expected P:D "
+                         f"(e.g. 1:2)") from None
+    if not sep or p < 1 or d < 1:
+        raise ValueError(f"bad disaggregate spec {spec!r}: expected P:D "
+                         f"with both counts >= 1")
+    return p, d
+
+
+def run_kill_drill(replicas: int = 2, disaggregate: Optional[str] = None,
+                   requests: int = 6, kill_after: int = 2,
+                   max_new: int = 6, timeout: float = 120.0) -> dict:
+    """Run the drill; return a JSON-able report with ``ok`` verdict."""
+    import threading
+
+    from bigdl_tpu.models.router import LMRouter
+    from bigdl_tpu.models.serving import ContinuousLMServer
+    from bigdl_tpu.resilience.chaos import KillReplicaAfterRequests
+    from bigdl_tpu.telemetry import MetricsRegistry, instruments
+
+    split = parse_split(disaggregate)
+    n_decode = split[1] if split else int(replicas)
+    n_prefill = split[0] if split else 0
+    if n_decode < 2:
+        raise ValueError("the kill drill needs >= 2 decode replicas "
+                         "(killing the only one proves nothing)")
+
+    registry = MetricsRegistry()
+    kill = KillReplicaAfterRequests(kill_after)
+    decode = [ContinuousLMServer(_mk_model(), slots=2, max_len=48,
+                                 greedy=True, decode_block=2,
+                                 registry=registry,
+                                 chaos=[kill] if i == 0 else None)
+              for i in range(n_decode)]
+    prefill = [ContinuousLMServer(_mk_model(), slots=1, max_len=48,
+                                  greedy=True, registry=registry)
+               for _ in range(n_prefill)]
+    router = LMRouter(decode, prefill_replicas=prefill, registry=registry)
+
+    prompts = [[(3 * i + j) % (VOCAB - 1) + 1 for j in range(2 + i % 3)]
+               for i in range(int(requests))]
+    results = [None] * len(prompts)
+    errors = [None] * len(prompts)
+
+    def worker(i):
+        try:
+            results[i] = router.submit(prompts[i], max_new, timeout=timeout)
+        except Exception as e:  # the drill REPORTS losses, not crashes
+            errors[i] = f"{type(e).__name__}: {e}"
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout)
+        mismatches = [i for i, ids in enumerate(prompts)
+                      if errors[i] is None
+                      and results[i] != _reference(ids, max_new)]
+        lost = [i for i in range(len(prompts)) if errors[i] is not None]
+        tm = instruments(registry)
+        report = {
+            "ok": not lost and not mismatches and kill.fired,
+            "requests": len(prompts),
+            "lost": [{"i": i, "error": errors[i]} for i in lost],
+            "mismatched": mismatches,
+            "kill_fired": kill.fired,
+            "kill_after": kill_after,
+            "decode_replicas": n_decode,
+            "prefill_replicas": n_prefill,
+            "replica_states": [r["state"] for r in
+                               router.health_extra["replicas"]],
+            "requeues": int(tm.router_requeues_total.value),
+            "retries": int(tm.router_retries_total.value),
+        }
+        return report
+    finally:
+        router.close()

@@ -221,7 +221,8 @@ def cmd_scoreboard(args) -> int:
             prefill_chunk=args.prefill_chunk, workload=args.workload,
             templates=args.templates, template_len=args.template_len,
             prefix_cache=(args.prefix_cache == "on"), draft=args.draft,
-            spec_len=args.spec_len)
+            spec_len=args.spec_len, replicas=args.replicas,
+            disaggregate=args.disaggregate)
         artifact = sb.run(cfg)
     body = json.dumps(artifact, indent=2)
     if args.out:
@@ -322,6 +323,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="speculative decode: 'identical' (same-weights "
                          "draft — the acceptance-rate ceiling) or 'int8' "
                          "(quantized-twin self-speculation)")
+    ps.add_argument("--replicas", type=int, default=1,
+                    help="route the workload over N in-process replicas "
+                    "behind the fleet router (models.router.LMRouter)")
+    ps.add_argument("--disaggregate", default=None, metavar="P:D",
+                    help="prefill:decode replica split, e.g. 1:2 — "
+                    "dedicated prefill replicas ship serialized state "
+                    "partitions to decode replicas (overrides --replicas)")
     ps.add_argument("--spec-len", type=int, dest="spec_len", default=4,
                     help="draft tokens proposed per speculative round")
     ps.add_argument("--out", default="",

@@ -64,6 +64,26 @@ METRIC_SPECS: List[MetricSpec] = [
                "TTFT of admissions that prefilled cold (prefix-cache "
                "miss). Only populated while the prefix cache is enabled.",
                (), DEFAULT_LATENCY_BUCKETS),
+    # ---- serving fleet: drain / handoff / router (models/router.py)
+    MetricSpec("bigdl_serving_drains_total", "counter",
+               "Graceful drains entered by a continuous server (SIGTERM "
+               "or drain()): admission stops, in-flight slots leave as "
+               "handoff cursors."),
+    MetricSpec("bigdl_router_requests_total", "counter",
+               "Requests accepted by the fleet router (counted once per "
+               "request, before any dispatch attempts)."),
+    MetricSpec("bigdl_router_retries_total", "counter",
+               "Dispatch attempts re-tried against another replica after "
+               "a failed or rejected attempt (bounded, with backoff)."),
+    MetricSpec("bigdl_router_requeues_total", "counter",
+               "Requests re-dispatched WITH a handoff cursor after their "
+               "replica died or drained mid-flight (a subset of "
+               "retries: the request had been accepted)."),
+    MetricSpec("bigdl_handoff_seconds", "histogram",
+               "Wall-clock of producing one serialized prefill handoff "
+               "partition on a prefill replica (disaggregation's ship "
+               "cost, observed by the router).",
+               (), DEFAULT_LATENCY_BUCKETS),
     # ---- cross-request KV prefix cache (models/prefix_cache.py)
     MetricSpec("bigdl_prefix_cache_hits", "counter",
                "Admissions whose chunk-aligned token prefix matched a "

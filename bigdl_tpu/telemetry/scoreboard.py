@@ -17,8 +17,9 @@ Three modes behind ``python -m bigdl_tpu.telemetry scoreboard`` /
   artifact (+ markdown with ``--markdown``);
 - **scrape <url>**: snapshot an EXISTING server's ``/metrics`` into a
   one-row artifact (no jax, no model — operator-side);
-- **diff <old> <new>**: compare artifacts row-by-row (matched on slots)
-  and exit 1 past the thresholds.
+- **diff <old> <new>**: compare artifacts row-by-row (matched on slots
+  plus fleet shape — replicas and prefill:decode split) and exit 1 past
+  the thresholds.
 
 Workload determinism: prompt lengths are drawn from a Zipf-weighted
 rank distribution over [lmin, lmax] and token ids uniformly from the
@@ -79,7 +80,8 @@ class ScoreboardConfig:
                  prefill_chunk: int = 16, workload: str = "zipf",
                  templates: int = 4, template_len: int = 48,
                  prefix_cache: bool = True, draft: bool = False,
-                 spec_len: int = 4):
+                 spec_len: int = 4, replicas: int = 1,
+                 disaggregate: Optional[str] = None):
         self.slots = [int(s) for s in slots]
         self.requests = int(requests)
         self.clients = max(1, int(clients))
@@ -125,6 +127,26 @@ class ScoreboardConfig:
             raise ValueError(f"draft must be False, 'identical' or "
                              f"'int8', got {draft!r}")
         self.spec_len = int(spec_len)
+        # round-12 fleet levers: replicas > 1 routes the workload over N
+        # in-process servers via models.router.LMRouter; disaggregate
+        # "P:D" splits admission prefill onto dedicated prefill replicas
+        # shipping serialized state partitions to D decode replicas
+        # (overrides replicas). Rows then carry replicas/split columns
+        # and the diff gate keys on (slots, replicas, split).
+        if disaggregate:
+            from bigdl_tpu.resilience.serving_drill import parse_split
+            p, d = parse_split(str(disaggregate))
+            self.disaggregate = f"{p}:{d}"
+            self.replicas = d
+            self.prefill_replicas = p
+        else:
+            self.disaggregate = None
+            self.replicas = max(1, int(replicas))
+            self.prefill_replicas = 0
+        if self.draft and (self.replicas > 1 or self.prefill_replicas):
+            raise ValueError("draft does not compose with a fleet (state "
+                             "handoff is incompatible with speculative "
+                             "serving)")
         tpl = self.template_len if workload == "shared-prefix" else 0
         self.max_len = tpl + self.lmax + self.max_new + 8
 
@@ -148,6 +170,9 @@ class ScoreboardConfig:
                                 "draft": ("identical-weights"
                                           if self.draft == "identical"
                                           else "int8-self")}
+        if self.replicas > 1 or self.prefill_replicas:
+            d["fleet"] = {"replicas": self.replicas,
+                          "disaggregate": self.disaggregate}
         return d
 
 
@@ -247,14 +272,32 @@ def _drive_one(cfg: ScoreboardConfig, slots: int) -> dict:
     elif cfg.draft == "int8":
         from bigdl_tpu.nn.quantized import quantize_model
         draft = quantize_model(_build_model(cfg))
-    server = ContinuousLMServer(model, slots=slots, max_len=cfg.max_len,
-                                decode_block=cfg.decode_block, greedy=True,
-                                max_new_tokens=cfg.max_new,
-                                seed=cfg.seed, registry=registry,
-                                prefill_mode=cfg.prefill_mode,
-                                prefill_chunk=cfg.prefill_chunk,
-                                prefix_cache=cfg.prefix_cache,
-                                draft=draft, spec_len=cfg.spec_len)
+
+    def mk_server(mdl, n_slots):
+        return ContinuousLMServer(mdl, slots=n_slots, max_len=cfg.max_len,
+                                  decode_block=cfg.decode_block, greedy=True,
+                                  max_new_tokens=cfg.max_new,
+                                  seed=cfg.seed, registry=registry,
+                                  prefill_mode=cfg.prefill_mode,
+                                  prefill_chunk=cfg.prefill_chunk,
+                                  prefix_cache=cfg.prefix_cache,
+                                  draft=draft, spec_len=cfg.spec_len)
+
+    if cfg.replicas > 1 or cfg.prefill_replicas:
+        # fleet row: each replica needs its own module instance (one
+        # module cannot hold two decode states); same-seed rebuilds keep
+        # the weights bit-identical, the handoff contract
+        from bigdl_tpu.models.router import LMRouter
+        decode = [mk_server(model if i == 0 else _build_model(cfg), slots)
+                  for i in range(cfg.replicas)]
+        prefill = [mk_server(_build_model(cfg), 1)
+                   for _ in range(cfg.prefill_replicas)]
+        server = LMRouter(decode, prefill_replicas=prefill,
+                          registry=registry)
+        prefix_enabled = decode[0].prefix_cache_enabled
+    else:
+        server = mk_server(model, slots)
+        prefix_enabled = server.prefix_cache_enabled
     prompts = make_prompts(cfg)
     errors: List[str] = []
     lock = threading.Lock()
@@ -307,7 +350,7 @@ def _drive_one(cfg: ScoreboardConfig, slots: int) -> dict:
     p_hits = tm.prefix_cache_hits.value
     p_miss = tm.prefix_cache_misses.value
     hit_rate = (round(p_hits / (p_hits + p_miss), 3)
-                if server.prefix_cache_enabled and (p_hits + p_miss)
+                if prefix_enabled and (p_hits + p_miss)
                 else None)
     proposed = tm.spec_proposed_tokens_total.value
     accepted = tm.spec_accepted_tokens_total.value
@@ -317,6 +360,8 @@ def _drive_one(cfg: ScoreboardConfig, slots: int) -> dict:
     ttft_miss = tm.serving_ttft_miss_seconds.labels().snapshot()
     return {
         "slots": slots,
+        "replicas": cfg.replicas,
+        "split": cfg.disaggregate,
         "prefill_mode": cfg.prefill_mode,
         "requests": len(prompts),
         "failed": len(errors),
@@ -483,12 +528,19 @@ def render_markdown(artifact: dict) -> str:
     with_prefix = any(r.get("prefix_hit_rate") is not None or
                       r.get("ttft_hit_p50_s") is not None for r in rows)
     with_spec = any(r.get("spec_accept_rate") is not None for r in rows)
+    with_fleet = any((r.get("replicas") or 1) != 1 or r.get("split")
+                     for r in rows)
     w = artifact.get("workload", {})
     z = w.get("zipf", {})
-    head = ("| slots | prefill | tok/s | TTFT p50 (ms) | TTFT p95 (ms) |"
-            " per-token (ms) |")
-    rule = ("|------:|:--------|------:|--------------:|--------------:|"
-            "---------------:|")
+    head = "| slots |"
+    rule = "|------:|"
+    if with_fleet:
+        head += " replicas | split |"
+        rule += "---------:|:------|"
+    head += (" prefill | tok/s | TTFT p50 (ms) | TTFT p95 (ms) |"
+             " per-token (ms) |")
+    rule += (":--------|------:|--------------:|--------------:|"
+             "---------------:|")
     if with_prefix:
         head += " hit rate | TTFT hit p50 (ms) | TTFT miss p50 (ms) |"
         rule += "---------:|------------------:|-------------------:|"
@@ -500,8 +552,11 @@ def render_markdown(artifact: dict) -> str:
     lines = [head, rule]
     for r in rows:
         tok_s = r.get("tok_s")
-        cells = [
-            f"{r.get('slots', '?')}",
+        cells = [f"{r.get('slots', '?')}"]
+        if with_fleet:
+            cells += [f"{r.get('replicas') or 1}",
+                      f"{r.get('split') or '—'}"]
+        cells += [
             f"{r.get('prefill_mode') or '—'}",
             f"{tok_s if tok_s is not None else '—'}",
             _fmt_ms(r.get("ttft_p50_s")),
@@ -531,9 +586,29 @@ def render_markdown(artifact: dict) -> str:
     if w.get("speculative"):
         meta += (f", speculative k={w['speculative'].get('spec_len', '?')}"
                  f" ({w['speculative'].get('draft', '?')} draft)")
+    fl = w.get("fleet") or {}
+    if fl.get("replicas"):
+        meta += f", fleet replicas={fl['replicas']}"
+        if fl.get("disaggregate"):
+            meta += f" disaggregated {fl['disaggregate']} prefill:decode"
     lines.append("")
     lines.append(f"<small>{meta}</small>")
     return "\n".join(lines)
+
+
+def _row_key(r: dict) -> tuple:
+    """Diff identity of a row: fleet shape included, with pre-round-12
+    artifacts (no replicas/split keys) reading as single-replica rows."""
+    return (r.get("slots"), r.get("replicas") or 1, r.get("split") or None)
+
+
+def _row_tag(r: dict) -> str:
+    tag = f"slots={r.get('slots')}"
+    if (r.get("replicas") or 1) != 1:
+        tag += f",replicas={r.get('replicas')}"
+    if r.get("split"):
+        tag += f",split={r.get('split')}"
+    return tag
 
 
 def _rise(old: Optional[float], new: Optional[float]) -> Optional[float]:
@@ -550,14 +625,13 @@ def diff(old: dict, new: dict,
     the gate never fails on missing data, only on measured regressions."""
     th = dict(DEFAULT_THRESHOLDS)
     th.update(thresholds or {})
-    by_slots = {r.get("slots"): r for r in old.get("rows", [])}
+    by_key = {_row_key(r): r for r in old.get("rows", [])}
     out: List[str] = []
     for nr in new.get("rows", []):
-        s = nr.get("slots")
-        orow = by_slots.get(s)
+        orow = by_key.get(_row_key(nr))
         if orow is None:
-            continue                    # new slot count: nothing to gate
-        tag = f"slots={s}"
+            continue           # new slot count / fleet shape: no gate yet
+        tag = _row_tag(nr)
         o_tok, n_tok = orow.get("tok_s"), nr.get("tok_s")
         if o_tok and n_tok is not None and \
                 n_tok < o_tok * (1 - th["tok_s_drop"]):
@@ -581,10 +655,11 @@ def diff(old: dict, new: dict,
                        f"{orow['peak_memory_bytes']} -> "
                        f"{nr['peak_memory_bytes']} "
                        f"(rise > {th['peak_memory_rise']:.0%})")
-    for s in by_slots:
-        if s not in {r.get("slots") for r in new.get("rows", [])}:
-            out.append(f"slots={s}: row present in old artifact but "
-                       "missing from new")
+    new_keys = {_row_key(r) for r in new.get("rows", [])}
+    for key, orow in by_key.items():
+        if key not in new_keys:
+            out.append(f"{_row_tag(orow)}: row present in old artifact "
+                       "but missing from new")
     return out
 
 

@@ -8,8 +8,9 @@
 #   ./scripts/bigdl-tpu.sh metrics [url|--selftest]   # scrape /metrics
 #   ./scripts/bigdl-tpu.sh trace [file|--selftest]    # Chrome trace tools
 #   ./scripts/bigdl-tpu.sh scoreboard [...|diff a b]  # serving scoreboard
-#   ./scripts/bigdl-tpu.sh chaos {corrupt|selftest} ...  # fault injection
+#   ./scripts/bigdl-tpu.sh chaos {corrupt|selftest|drill} ...  # fault injection
 #   ./scripts/bigdl-tpu.sh resilience {validate|latest} <ckpt_dir>
+#   ./scripts/bigdl-tpu.sh serve [--replicas N] [--disaggregate P:D] ...
 set -euo pipefail
 
 # --- lint subcommand: graftlint, the whole-program JAX-hazard analyzer
@@ -43,8 +44,10 @@ if [[ "${1:-}" == "metrics" || "${1:-}" == "trace" \
 fi
 
 # --- resilience subcommands (docs/RESILIENCE.md): snapshot audits and
-#     deterministic fault injection against checkpoint directories.
+#     deterministic fault injection against checkpoint directories, plus
+#     the serving-plane kill-one-replica drill.
 #       ./scripts/bigdl-tpu.sh chaos corrupt /ckpt/model.40 --mode flip
+#       ./scripts/bigdl-tpu.sh chaos drill --disaggregate 1:2
 #       ./scripts/bigdl-tpu.sh resilience validate /ckpt
 if [[ "${1:-}" == "chaos" || "${1:-}" == "resilience" ]]; then
   sub="$1"; shift
@@ -54,6 +57,17 @@ if [[ "${1:-}" == "chaos" || "${1:-}" == "resilience" ]]; then
     exec python -m bigdl_tpu.resilience chaos "$@"
   fi
   exec python -m bigdl_tpu.resilience "$@"
+fi
+
+# --- serving fleet (docs/RESILIENCE.md): stdlib HTTP front over N
+#     in-process replicas with graceful SIGTERM drain; --disaggregate
+#     P:D splits prefill from decode replicas.
+#       ./scripts/bigdl-tpu.sh serve --replicas 2 --port 8000
+if [[ "${1:-}" == "serve" ]]; then
+  shift
+  root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+  export PYTHONPATH="$root${PYTHONPATH:+:$PYTHONPATH}"
+  exec python -m bigdl_tpu.apps.transformer serve "$@"
 fi
 
 # --- compilation cache: first compile of a big model is 20-40s; persist it

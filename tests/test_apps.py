@@ -366,6 +366,7 @@ class TestPerfHarness:
         loss = float(crit.apply(out, jnp.asarray(batch.labels)))
         assert loss < 2.0, f"LM failed to learn the grammar: {loss}"
 
+    @pytest.mark.slow  # ~13s: full perf-harness compile; tier-1 wall budget
     def test_transformer_perf_workload(self, capsys):
         perf.main(["--model", "transformer", "-b", "2", "-i", "2",
                    "--warmup", "1", "--precision", "fp32"])
@@ -373,6 +374,7 @@ class TestPerfHarness:
         assert rec["model"] == "transformer"
         assert rec["records_per_sec_incl_compile"] > 0
 
+    @pytest.mark.slow  # ~17s: MoE perf-harness compile; tier-1 wall budget
     def test_perf_moe_flag_builds_moe_model(self, capsys):
         perf.main(["--model", "transformer", "-b", "2", "-i", "1",
                    "--warmup", "1", "--precision", "fp32",
@@ -380,6 +382,7 @@ class TestPerfHarness:
         rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert rec["records_per_sec_incl_compile"] > 0
 
+    @pytest.mark.slow  # ~16s: adamw+remat perf compile; tier-1 wall budget
     def test_perf_adamw_remat_block(self, capsys):
         perf.main(["--model", "transformer", "-b", "2", "-i", "1",
                    "--warmup", "1", "--precision", "fp32",
@@ -394,6 +397,7 @@ class TestIngestBench:
     decode stages produce sane JSON on a tiny corpus (the on-chip train
     stage and full-size corpus are exercised by the PERF.md runs)."""
 
+    @pytest.mark.slow  # ~10s: three ingest stages; tier-1 wall budget
     def test_generate_read_decode(self, tmp_path, capsys):
         from bigdl_tpu.apps import ingest_bench
         out = str(tmp_path / "shards")

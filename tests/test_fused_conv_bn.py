@@ -133,6 +133,7 @@ def test_eval_folding_preserves_bf16():
                                want, rtol=5e-2, atol=5e-2)
 
 
+@pytest.mark.slow  # ~10s: full inception-v2 build; tier-1 wall budget
 def test_inception_v2_builder_flag(monkeypatch):
     from bigdl_tpu.models import inception
     monkeypatch.setenv("BIGDL_TPU_FUSED_1X1", "1")

@@ -133,6 +133,7 @@ class TestEos:
 
 
 class TestDecodeInternals:
+    @pytest.mark.slow  # ~14s: deep decode on 1-core CPU; tier-1 wall budget
     def test_long_decode_positions(self):
         """Positional offsets stay correct deep into the decode (cache mostly
         written by decode steps, not the prefill)."""
@@ -538,6 +539,7 @@ class TestLlamaRecipe:
         out = layer.evaluate_mode().forward(jnp.ones((1, 4, 16)))
         assert out.shape == (1, 4, 16)
 
+    @pytest.mark.slow  # ~10s: train+generate e2e; tier-1 wall budget
     def test_llama_recipe_trains_and_generates(self):
         from bigdl_tpu.dataset.base import DataSet, Sample, SampleToBatch
         from bigdl_tpu.optim import AdamW, Optimizer, Trigger

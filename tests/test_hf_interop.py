@@ -72,6 +72,7 @@ def our_logprobs(model, hf_ids):
 
 
 class TestGPT2Parity:
+    @pytest.mark.slow  # ~10s: highest-precision double forward; tier-1 wall budget
     def test_logit_parity(self):
         cfg, hf = tiny_gpt2()
         ids = np.random.default_rng(0).integers(0, 97, (2, 24))

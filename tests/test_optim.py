@@ -352,6 +352,7 @@ class TestBlockRemat:
             jnp.asarray(y))
         return jax.grad(loss_fn, has_aux=True)(lm.parameter_tree())[0]
 
+    @pytest.mark.slow  # ~15s: double grad compile; tier-1 wall budget
     def test_block_remat_gradients_exact(self):
         import jax
         import numpy as np

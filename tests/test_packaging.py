@@ -220,6 +220,46 @@ def test_scoreboard_r03_shared_prefix_artifacts():
         assert 0.5 <= r["spec_accept_rate"] <= 1.0
 
 
+def test_scoreboard_diff_r03_to_r04_checked_in_artifacts():
+    """The round-12 before/after gate on the CHECKED-IN artifacts: r03
+    (single server) -> r04 (fleet rows added) on the SAME legacy Zipf
+    workload. The diff keys rows on (slots, replicas, split), so r04's
+    fleet rows gate against nothing yet while its replicas=1 rows must
+    clear the same wide cross-session wall-clock tolerances the r02->r03
+    gate uses (different hosts; the structural `compiles_rise: 0` stays
+    at its strict default). Fleet structural claims: every row served
+    its whole workload (failed == 0), the aggregated N-replica rows
+    compile exactly N x the single-server O(1) program set, and the
+    disaggregated row compiles FEWER programs than the same-size
+    aggregated fleet — its decode replicas admit from shipped state
+    partitions and never build the chunked-prefill pair."""
+    import json
+
+    launcher = os.path.join(REPO, "scripts", "bigdl-tpu.sh")
+    r03 = os.path.join(REPO, "SCOREBOARD_r03.json")
+    r04 = os.path.join(REPO, "SCOREBOARD_r04.json")
+    r = subprocess.run([launcher, "scoreboard", "diff", r03, r04,
+                        "--max-tok-drop", "0.4",
+                        "--max-ttft-rise", "2.0",
+                        "--max-latency-rise", "1.0"],
+                       capture_output=True, timeout=60)
+    assert r.returncode == 0, r.stderr.decode(errors="replace")
+    assert b"no regressions" in r.stdout
+    rows = json.load(open(r04))["rows"]
+    assert all(r["failed"] == 0 for r in rows)
+    solo = {r["slots"] for r in rows
+            if (r.get("replicas") or 1) == 1 and not r.get("split")}
+    assert solo >= {8, 16, 32}      # every r03 row has an r04 partner
+    agg = {r["replicas"]: r for r in rows
+           if r["replicas"] > 1 and not r.get("split")}
+    assert set(agg) >= {2, 3}
+    for n, row in agg.items():
+        assert row["compiles"] == 4 * n
+    disagg = [r for r in rows if r.get("split")]
+    assert disagg and disagg[0]["split"] == "1:2"
+    assert disagg[0]["compiles"] < agg[2]["compiles"]
+
+
 def test_launcher_lint_sarif_smoke(tmp_path):
     """`bigdl-tpu.sh lint --sarif` must produce a well-formed SARIF
     2.1.0 document through the launcher (the CI-annotation path), even

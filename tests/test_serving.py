@@ -48,6 +48,8 @@ class TestContinuousCorrectness:
         finally:
             srv.close()
 
+    @pytest.mark.slow  # ~16s: widest in-flight mix; the per-geometry
+    # bit-exactness gates above stay fast-tier (tier-1 wall budget)
     def test_mixed_lengths_share_slots(self):
         """Different prompt lengths and budgets IN FLIGHT TOGETHER must
         each match their solo reference — per-row cache positions at
@@ -321,7 +323,12 @@ class TestChunkedPrefill:
         lens.append(max_len - max_new)
         return lens
 
-    @pytest.mark.parametrize("mode", ["chunked", "bucketed"])
+    @pytest.mark.parametrize("mode", [
+        "chunked",
+        # bucketed (the pow2 fallback mode) rides the slow tier for the
+        # tier-1 wall budget; chunked is the default-path gate
+        pytest.param("bucketed", marks=pytest.mark.slow),
+    ])
     def test_bit_exact_vs_monolithic_prefill(self, mode):
         max_len, max_new = 32, 4
         model, ref = _mk_model(), _mk_model()
@@ -481,6 +488,8 @@ class TestSpeculativeDecode:
                                   prefill_chunk=4, draft=draft,
                                   spec_len=spec_len, registry=registry)
 
+    @pytest.mark.slow  # ~8s: tier-1 wall budget; the adversarial-draft
+    # gate below keeps spec-decode bit-exactness fast-tier
     def test_identical_draft_bit_exact_full_acceptance(self):
         from bigdl_tpu.telemetry import MetricsRegistry, instruments
         registry = MetricsRegistry()
@@ -518,6 +527,7 @@ class TestSpeculativeDecode:
         accepted = tm.spec_accepted_tokens_total.value
         assert 0 <= accepted < proposed
 
+    @pytest.mark.slow  # ~11s: widest spec mix; tier-1 wall budget
     def test_mixed_inflight_each_matches_solo(self):
         """Per-row rollback under load: rows at different positions with
         different acceptance in the SAME verify dispatch must not bleed

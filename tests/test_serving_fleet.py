@@ -1,0 +1,356 @@
+"""Zero-loss serving fleet (round 12, ROADMAP #1).
+
+The contract under test: a ``ContinuousLMServer`` leaving service —
+gracefully (SIGTERM -> ``drain()``) or violently (decode failure ->
+die) — loses ZERO accepted requests, because every interrupted request
+leaves as a host-side ``HandoffCursor`` (prompt + emitted tokens) that
+a peer replica resumes via deterministic chunked re-prefill, keeping
+the greedy continuation bit-identical to an unkilled run. On top:
+``LMRouter`` unit behaviour (least-loaded dispatch, bounded retry,
+requeue-with-cursor) against stub replicas, the draining-vs-dead
+submit/health distinction, the serialized prefill-handoff round-trip
+(disaggregation's wire format), and the kill-one-replica drill itself.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.models import transformer
+from bigdl_tpu.models.generation import (deserialize_prefill_state,
+                                         generate)
+from bigdl_tpu.models.router import LMRouter, Replica
+from bigdl_tpu.models.serving import (ContinuousLMServer, HandoffCursor,
+                                      ReplicaUnavailable, ServerDead,
+                                      ServerDraining)
+from bigdl_tpu.telemetry import MetricsRegistry, instruments
+from bigdl_tpu.utils.rng import manual_seed
+
+VOCAB = 24
+
+
+def _mk_model(seed=4):
+    manual_seed(seed)
+    return transformer.build_lm(VOCAB, 16, 2, 32, num_layers=2, max_len=64,
+                                rope=True, activation="swiglu", norm="rms",
+                                tie_embeddings=True)
+
+
+def _ref_continuation(ref_model, ids, max_new):
+    out = np.asarray(generate(ref_model, jnp.asarray(
+        np.asarray(ids, np.float32)[None]), max_new, greedy=True))
+    return out[0, len(ids):].astype(int).tolist()
+
+
+# ---------------------------------------------------------------------------
+# Router units: jax-free stub replicas
+# ---------------------------------------------------------------------------
+
+class _StubServer:
+    """Duck-typed replica: records submits, scripted to fail."""
+
+    def __init__(self, depth=0, fail=None, sticky=True):
+        self.queue_depth = depth
+        self.dead_reason = None
+        self.drain_reason = None
+        self.batches_served = 0
+        self.submits = []
+        self._fail = list(fail or [])
+        self._sticky = sticky
+        self.closed = 0
+        self.drained = []
+
+    def submit(self, ids, max_new=None, timeout=None, *, emitted=None,
+               state=None):
+        self.submits.append((list(ids), emitted, state))
+        if self._fail:
+            err = self._fail.pop(0)
+            # mirror the real lifecycle (unless sticky=False): a replica
+            # that raised draining/dead REPORTS that state, so the
+            # router's health check routes around it on the retry
+            if self._sticky and isinstance(err, ServerDraining):
+                self.drain_reason = str(err)
+            elif self._sticky and isinstance(err, ServerDead):
+                self.dead_reason = str(err)
+            raise err
+        return (emitted or []) + [7, 8]
+
+    def drain(self, reason="x"):
+        self.drained.append(reason)
+        self.drain_reason = reason
+
+    def close(self):
+        self.closed += 1
+
+
+class TestRouterUnits:
+    def test_least_loaded_dispatch_skips_busy_and_unhealthy(self):
+        idle, busy, dead = _StubServer(0), _StubServer(5), _StubServer(0)
+        dead.dead_reason = "gone"
+        router = LMRouter([busy, dead, idle], registry=MetricsRegistry())
+        assert router.submit([1, 2], 2) == [7, 8]
+        assert idle.submits and not busy.submits and not dead.submits
+
+    def test_round_robin_tie_break_spreads_equal_replicas(self):
+        a, b = _StubServer(), _StubServer()
+        router = LMRouter([a, b], registry=MetricsRegistry())
+        for _ in range(4):
+            router.submit([1], 1)
+        assert a.submits and b.submits
+
+    def test_retry_moves_rejected_dispatch_to_peer(self):
+        flaky = _StubServer(fail=[ServerDraining("draining: sigterm")])
+        steady = _StubServer(depth=1)     # higher load: tried second
+        reg = MetricsRegistry()
+        router = LMRouter([flaky, steady], registry=reg, backoff_s=0.001)
+        assert router.submit([1, 2], 2) == [7, 8]
+        assert steady.submits == [([1, 2], None, None)]
+        tm = instruments(reg)
+        assert tm.router_retries_total.value == 1
+        assert tm.router_requeues_total.value == 0
+
+    def test_requeue_carries_the_cursor_progress(self):
+        cursor = HandoffCursor(ids=[1, 2], emitted=[5, 9], max_new=4)
+        flaky = _StubServer(fail=[ServerDead("died mid-flight",
+                                             cursor=cursor)])
+        steady = _StubServer(depth=1)
+        reg = MetricsRegistry()
+        router = LMRouter([flaky, steady], registry=reg, backoff_s=0.001)
+        assert router.submit([1, 2], 4) == [5, 9, 7, 8]
+        # the peer was asked to RESUME, not restart
+        assert steady.submits == [([1, 2], [5, 9], None)]
+        assert instruments(reg).router_requeues_total.value == 1
+
+    def test_bounded_retries_then_raise(self):
+        # sticky=False: the replica keeps CLAIMING health while every
+        # dispatch bounces — the bounded-retry ceiling is what stops an
+        # infinite loop against such a liar
+        always = _StubServer(fail=[ServerDraining("no") for _ in range(9)],
+                             sticky=False)
+        router = LMRouter([always], registry=MetricsRegistry(),
+                          max_retries=2, backoff_s=0.001)
+        with pytest.raises(ReplicaUnavailable):
+            router.submit([1], 1)
+        assert len(always.submits) == 3   # initial + 2 retries
+
+    def test_no_healthy_replica_raises_server_dead(self):
+        a = _StubServer()
+        a.dead_reason = "boom"
+        router = LMRouter([a], registry=MetricsRegistry())
+        with pytest.raises(ServerDead, match="no healthy replicas"):
+            router.submit([1], 1)
+        assert router.dead_reason is not None
+
+    def test_health_surface_reports_per_replica_states(self):
+        ok, draining = _StubServer(), _StubServer()
+        draining.drain_reason = "sigterm"
+        router = LMRouter([ok, draining], registry=MetricsRegistry())
+        assert router.dead_reason is None  # one healthy replica suffices
+        states = {r["name"]: r["state"]
+                  for r in router.health_extra["replicas"]}
+        assert states == {"decode-0": "ok", "decode-1": "draining"}
+
+    def test_drain_and_close_fan_out_once_per_server(self):
+        a, b = _StubServer(), _StubServer()
+        router = LMRouter([a, b], prefill_replicas=[Replica(a, role="prefill")],
+                          registry=MetricsRegistry())
+        router.drain("fleet sigterm")
+        assert a.drained == ["fleet sigterm"] and b.drained
+        router.close()
+        assert a.closed == 1 and b.closed == 1   # a shared across roles
+
+
+# ---------------------------------------------------------------------------
+# Drain lifecycle on a live server
+# ---------------------------------------------------------------------------
+
+class TestDrainLifecycle:
+    def test_drain_is_distinct_from_dead_and_stops_admission(self):
+        srv = ContinuousLMServer(_mk_model(), slots=2, max_len=32,
+                                 greedy=True, decode_block=2)
+        try:
+            assert len(srv.submit([3, 7, 2], 3, timeout=120)) == 3
+            srv.drain("sigterm drill")
+            assert srv.drain_reason == "sigterm drill"
+            assert srv.dead_reason is None
+            t0 = time.perf_counter()
+            with pytest.raises(ServerDraining, match="draining"):
+                srv.submit([2, 2], 3, timeout=120)
+            assert time.perf_counter() - t0 < 1.0   # fail-fast, no queue
+        finally:
+            srv.close()
+
+    def test_drain_midflight_snapshots_cursor_and_peer_resumes(self):
+        """The migrate path end to end: drain a server mid-generation,
+        catch the cursor, resume prompt+emitted on a PEER — the stitched
+        output must be bit-identical to an uninterrupted reference."""
+        ref = _mk_model()
+        a = ContinuousLMServer(_mk_model(), slots=1, max_len=48,
+                               greedy=True, decode_block=1)
+        b = ContinuousLMServer(_mk_model(), slots=1, max_len=48,
+                               greedy=True, decode_block=2)
+        ids, max_new = [3, 7, 2, 9], 10
+        box = {}
+
+        def client():
+            try:
+                a.submit(ids, max_new, timeout=120)
+            except ServerDraining as e:
+                box["cursor"] = e.cursor
+
+        try:
+            t = threading.Thread(target=client)
+            t.start()
+            deadline = time.time() + 60
+            while a.requests_admitted < 1 and time.time() < deadline:
+                time.sleep(0.01)
+            a.drain("preemption notice")
+            t.join(timeout=60)
+            cur = box.get("cursor")
+            assert cur is not None and cur.ids == ids
+            full = _ref_continuation(ref, ids, max_new)
+            assert cur.emitted == full[:len(cur.emitted)]
+            remaining = max_new - len(cur.emitted)
+            assert remaining > 0   # drained mid-flight, not at the end
+            resumed = b.submit(ids, max_new, timeout=120,
+                               emitted=cur.emitted)
+            assert resumed == full
+        finally:
+            a.close()
+            b.close()
+
+    def test_close_is_idempotent_with_concurrent_drain(self):
+        srv = ContinuousLMServer(_mk_model(), slots=1, max_len=32,
+                                 greedy=True)
+        try:
+            threads = [threading.Thread(target=srv.drain)
+                       for _ in range(3)] + \
+                      [threading.Thread(target=srv.close)
+                       for _ in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert srv.drain_reason is not None
+            assert srv.dead_reason is None
+            srv.close()                   # and again, after everything
+            with pytest.raises(ServerDraining):
+                srv.submit([1, 2], 2, timeout=5)
+        finally:
+            srv.close()
+
+    def test_drains_total_counts_once(self):
+        reg = MetricsRegistry()
+        srv = ContinuousLMServer(_mk_model(), slots=1, max_len=32,
+                                 greedy=True, registry=reg)
+        try:
+            srv.drain("a")
+            srv.drain("b")                 # second call: no-op
+            assert instruments(reg).serving_drains_total.value == 1
+            assert srv.drain_reason == "a"
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Disaggregation: the serialized prefill-handoff wire format
+# ---------------------------------------------------------------------------
+
+class TestPrefillHandoff:
+    def test_roundtrip_preserves_logprobs_and_peer_continues_identically(
+            self):
+        """One blob, two claims: deserializing reproduces the shipped
+        log-probs bit-for-bit, and a DECODE replica admitting from the
+        blob continues exactly like a replica that prefilled locally."""
+        ref = _mk_model()
+        a = ContinuousLMServer(_mk_model(), slots=1, max_len=48,
+                               greedy=True)
+        b = ContinuousLMServer(_mk_model(), slots=2, max_len=48,
+                               greedy=True, decode_block=2)
+        ids, max_new = [5, 11, 3, 8, 2], 8
+        try:
+            blob = a.prefill_handoff(ids)
+            lp, state = deserialize_prefill_state(blob)
+            lp2, _ = deserialize_prefill_state(blob)
+            assert np.array_equal(np.asarray(lp), np.asarray(lp2))
+            assert lp.shape == (1, VOCAB) and state
+            out = b.submit(ids, max_new, timeout=120, state=blob)
+            assert out == _ref_continuation(ref, ids, max_new)
+        finally:
+            a.close()
+            b.close()
+
+    def test_draining_prefill_replica_rejects_handoff(self):
+        srv = ContinuousLMServer(_mk_model(), slots=1, max_len=32,
+                                 greedy=True)
+        try:
+            srv.drain("going away")
+            with pytest.raises(ServerDraining):
+                srv.prefill_handoff([1, 2, 3])
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# The kill-one-replica drill: zero accepted requests lost
+# ---------------------------------------------------------------------------
+
+class TestKillDrill:
+    @pytest.mark.slow  # ~11s: 2-replica fleet compile; the cursor-resume
+    # bit-exactness gate stays fast-tier in TestDrainLifecycle
+    def test_kill_one_replica_loses_nothing(self):
+        """Replica 0 dies mid-stream (chaos kill-replica, the REAL die
+        path); every request completes via requeue-with-cursor on the
+        peer, bit-identical to the unkilled reference."""
+        from bigdl_tpu.resilience.serving_drill import run_kill_drill
+
+        report = run_kill_drill(replicas=2, requests=4, kill_after=1,
+                                max_new=5)
+        assert report["kill_fired"]
+        assert report["lost"] == [] and report["mismatched"] == []
+        assert report["ok"]
+        assert report["requeues"] >= 1
+        assert report["replica_states"][0] == "dead"
+
+    @pytest.mark.slow
+    def test_disaggregated_drill_with_dropped_handoff(self):
+        """The heavy variant: a 1:2 prefill:decode fleet where chaos
+        drops a shipped partition in transit AND a decode replica is
+        killed — re-ship plus requeue still lose nothing."""
+        from bigdl_tpu.models.serving import ContinuousLMServer as S
+        from bigdl_tpu.resilience.chaos import (DropHandoff,
+                                                KillReplicaAfterRequests)
+
+        ref = _mk_model()
+        reg = MetricsRegistry()
+        kill = KillReplicaAfterRequests(1)
+        decode = [S(_mk_model(), slots=2, max_len=48, greedy=True,
+                    decode_block=2, registry=reg,
+                    chaos=[kill] if i == 0 else None) for i in range(2)]
+        prefill = [S(_mk_model(), slots=1, max_len=48, greedy=True,
+                     registry=reg)]
+        router = LMRouter(decode, prefill_replicas=prefill, registry=reg,
+                          chaos=[DropHandoff(1)])
+        prompts = [[3, 7, 2, 9], [5, 1], [8, 8, 4], [2, 6, 6, 1, 9]]
+        results = [None] * len(prompts)
+
+        def worker(i):
+            results[i] = router.submit(prompts[i], 6, timeout=120)
+
+        try:
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(len(prompts))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            for i, ids in enumerate(prompts):
+                assert results[i] == _ref_continuation(ref, ids, 6), i
+            tm = instruments(reg)
+            assert tm.handoff_seconds.labels().snapshot()["count"] >= 1
+            assert tm.router_retries_total.value >= 1   # the drop re-ship
+        finally:
+            router.close()

@@ -33,6 +33,7 @@ class TestRoundTrip:
         np.testing.assert_allclose(np.asarray(dst.predict(x)),
                                    np.asarray(src.predict(x)), atol=1e-6)
 
+    @pytest.mark.slow  # ~10s: two LM builds + predicts; tier-1 wall budget
     def test_fused_and_unfused_tails_interchange(self):
         """The fused LMHead tail and TimeDistributed(Linear) tail share the
         lm_head.* keys, so checkpoints cross-load."""

@@ -236,6 +236,7 @@ class TestRemat:
             np.testing.assert_allclose(b, a, rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow  # ~11s: convergence loop; tier-1 wall budget
 def test_cifar_resnet_converges_under_fused_kernels(monkeypatch):
     # Fused conv+BN kernels (1x1 + 3x3, interpret mode on CPU) through the
     # REAL training path: loss must fall on a learnable synthetic task.
