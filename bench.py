@@ -17,6 +17,13 @@ cache, retry backend init on UNAVAILABLE, and fetch a scalar after every
 warmup step so a wedged tunnel fails fast instead of hanging in the
 timed loop.
 
+The numbers here are SYNTHETIC-INPUT ceilings (no host data path). The
+real-data ingest side is benchmarked by ``bigdl_tpu/apps/ingest_bench.py``
+— its ``pipeline`` mode A/Bs the serial host chain against the staged
+ingest engine (``dataset/ingest/``) and writes ``INGEST_r01.json`` /
+``INGEST_r01_trace.json``; comparing its rec/s against this file's
+img/s/chip says whether training is chip-bound or host-bound.
+
 Usage: python bench.py                 # full orchestrated run
        python bench.py --model lenet   # restrict to one workload
 """

@@ -173,6 +173,16 @@ MODES: Dict[str, List[Dict[str, str]]] = {
          "wire": "n_ring*(S_seq-1)*K",
          "note": "K/V block rotation, S_seq-1 hops per attention pass"},
     ],
+    "dp x cp": [
+        {"op": "collective-permute", "axis": "seq",
+         "payload": "n_ring*(S_seq-1)*K",
+         "wire": "n_ring*(S_seq-1)*K",
+         "note": "K/V rotation within each data group's seq coset "
+                 "(same ring as sp-ring, run S_data times in parallel)"},
+        {"op": "all-reduce", "axis": "data", "payload": "P",
+         "wire": "2*P*(S_data-1)/S_data",
+         "note": "replicated-parameter gradient sync across data groups"},
+    ],
     "pp-gpipe": [
         {"op": "collective-permute", "axis": "pipe",
          "payload": "2*n_micro*(S_pipe-1)*M",

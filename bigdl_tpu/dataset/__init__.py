@@ -7,6 +7,8 @@ from bigdl_tpu.dataset.base import (
     AbstractDataSet, LocalDataSet, DistributedDataSet, DataSet,
 )
 from bigdl_tpu.dataset.device_cache import DeviceCachedDataSet
+from bigdl_tpu.dataset.ingest import (IngestConfig, IngestEngine,
+                                      PrefetchingDataSet)
 from bigdl_tpu.dataset import image
 from bigdl_tpu.dataset import text
 from bigdl_tpu.dataset import mnist

@@ -132,14 +132,30 @@ class DeviceCachedDataSet(AbstractDataSet[MiniBatch]):
     def _materialize(self) -> None:
         if self._x is not None:
             return
+        import time as _time
+        t_fill = _time.perf_counter()
+        try:
+            self._materialize_inner()
+        finally:
+            # cold-start attribution (docs/OBSERVABILITY.md): the first
+            # step blocks on this whole-cache build — charge it to the
+            # ingest stall ledger so "why was step 1 slow" has an answer
+            # instead of vanishing into data-wait noise
+            from bigdl_tpu.telemetry import get_registry, instruments
+            instruments(get_registry()).ingest_stall_seconds_total.labels(
+                stage="materialize").inc(_time.perf_counter() - t_fill)
+
+    def _materialize_inner(self) -> None:
+        from bigdl_tpu.telemetry import span
         self._scan_for_stochastic_stages()
         import jax.numpy as jnp
         feats, labels = [], []
-        for s in self.base.data(train=False):
-            # Sample has .feature; the image types (LabeledImage) carry the
-            # array as .data with the same (feature, label) meaning
-            feats.append(s.feature if hasattr(s, "feature") else s.data)
-            labels.append(s.label)
+        with span("ingest.materialize", batch_size=self.batch_size):
+            for s in self.base.data(train=False):
+                # Sample has .feature; the image types (LabeledImage) carry
+                # the array as .data with the same (feature, label) meaning
+                feats.append(s.feature if hasattr(s, "feature") else s.data)
+                labels.append(s.label)
         if not feats:
             raise ValueError("DeviceCachedDataSet: wrapped dataset is empty")
         if self._mesh is None and len(feats) < self.batch_size:

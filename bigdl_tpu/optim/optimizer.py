@@ -668,6 +668,11 @@ class LocalOptimizer(Optimizer):
         handler = self._preemption
         if handler is not None:
             handler.install()
+            drain = getattr(self.dataset, "drain", None)
+            if callable(drain):
+                # ingest-engine datasets: stop + join the reader/decode/
+                # device-feed threads before the final snapshot's IO
+                handler.add_drain_hook(drain)
         try:
             while True:
                 try:
@@ -701,6 +706,7 @@ class LocalOptimizer(Optimizer):
                         "from checkpoint %s", len(failures), retry_times, e,
                         latest.model_path)
         finally:
+            self._close_data_iter()
             if handler is not None:
                 handler.uninstall()
 
@@ -955,6 +961,10 @@ class LocalOptimizer(Optimizer):
                 return True
 
             data_iter = iter(self.dataset.data(train=True))
+            # tracked for deterministic teardown: an engine-backed iterator
+            # owns worker threads; epoch end AND the exception path out of
+            # optimize() close it explicitly instead of waiting on GC
+            self._live_data_iter = data_iter
             epoch_batches = 0
             if (resume_cursor is not None
                     and int(resume_cursor.get("epoch", -1)) == epoch):
@@ -1092,6 +1102,7 @@ class LocalOptimizer(Optimizer):
                     break
                 t_data = time.time()
             flush()  # drain the pipeline at epoch end (exact epoch log)
+            self._close_data_iter()
             self.metrics.add("data wait time", data_wait)
             logger.info("[Epoch %d] Epoch finished. Wall clock time is %.1f ms (%d records)",
                         epoch, (time.time() - epoch_start) * 1e3, epoch_records)
@@ -1106,6 +1117,19 @@ class LocalOptimizer(Optimizer):
         model.load_buffer_tree(buffers)
         return model
 
+    def _close_data_iter(self) -> None:
+        """Close the tracked epoch iterator (no-op when none is live).
+        Generator-backed pipelines run their ``finally`` blocks —
+        engine-backed ones drain + join their stage threads — so the
+        data-wait accounting and thread census stay exact on every exit
+        path, exceptions included."""
+        it = getattr(self, "_live_data_iter", None)
+        self._live_data_iter = None
+        if it is not None:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+
     def _preempt_snapshot(self, params, buffers, opt_state,
                           driver_state) -> None:
         """End-of-step preemption snapshot: persist (model, state, RESUME
@@ -1117,6 +1141,11 @@ class LocalOptimizer(Optimizer):
         reason = (self._preemption.reason
                   if self._preemption is not None and self._preemption.reason
                   else "preempted")
+        if self._preemption is not None:
+            # drain ingest first: a live reader/decode pipeline would race
+            # shard reads and H2D transfers against snapshot IO inside the
+            # grace window
+            self._preemption.run_drain_hooks()
         final = self._finalize_params(params)
         snap_path = None
         if self.checkpoint_path is not None:

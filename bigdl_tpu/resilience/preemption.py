@@ -98,6 +98,7 @@ class PreemptionHandler:
         self._drained = 0          # consumer-side counter (metrics)
         self._prev: dict = {}
         self.installed = False
+        self._drain_hooks: list = []  # consumer-thread only, never the handler
 
     # ------------------------------------------------------------- lifecycle
     def install(self) -> "PreemptionHandler":
@@ -158,6 +159,28 @@ class PreemptionHandler:
         if self._t_notice is None:
             return float("inf")
         return self.grace_seconds - (time.monotonic() - self._t_notice)
+
+    # ---------------------------------------------------------- drain hooks
+    def add_drain_hook(self, fn) -> None:
+        """Register a callable the SNAPSHOT PATH runs before writing the
+        final snapshot (normal thread context, never the signal handler).
+        The optimizer registers its dataset's ingest ``drain()`` here so
+        reader/decoder threads are stopped and joined before checkpoint
+        IO starts — a live ingest pipeline would race shard reads and
+        device transfers against the snapshot inside the grace window."""
+        if fn not in self._drain_hooks:
+            self._drain_hooks.append(fn)
+
+    def run_drain_hooks(self) -> None:
+        """Run (and clear) the registered drain hooks; hook failures are
+        logged, not raised — a drain error must not cost the snapshot."""
+        hooks, self._drain_hooks = self._drain_hooks, []
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:
+                logger.exception("[Preemption] drain hook %r failed "
+                                 "(continuing to snapshot)", fn)
 
     def drain_notices(self) -> int:
         """Notices received since the last drain — called from the

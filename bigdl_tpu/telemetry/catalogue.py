@@ -148,6 +148,29 @@ METRIC_SPECS: List[MetricSpec] = [
     MetricSpec("bigdl_train_validation_seconds", "histogram",
                "Wall-clock of in-training validation passes.",
                ("mode",), DEFAULT_LATENCY_BUCKETS),
+    # ---- staged ingest engine (dataset/ingest/)
+    MetricSpec("bigdl_ingest_queue_depth", "gauge",
+               "Items waiting between ingest stages (stage = shards "
+               "done-but-unordered, chunks awaiting decode, batches "
+               "done-but-unordered, out = device-ready hand-off queue).",
+               ("stage",)),
+    MetricSpec("bigdl_ingest_stage_seconds", "histogram",
+               "Wall-clock of one ingest work unit (stage = read one "
+               "shard / decode one chunk / device_put one batch).",
+               ("stage",), DEFAULT_LATENCY_BUCKETS),
+    MetricSpec("bigdl_ingest_records_total", "counter",
+               "Records handed to the consumer by the ingest engine."),
+    MetricSpec("bigdl_ingest_bytes_total", "counter",
+               "Raw shard payload bytes read by the reader pool."),
+    MetricSpec("bigdl_ingest_batches_total", "counter",
+               "Batches handed to the consumer by the ingest engine."),
+    MetricSpec("bigdl_ingest_stall_seconds_total", "counter",
+               "Starvation attribution: time a stage waited for INPUT "
+               "while the pipeline had admission room (waits under "
+               "downstream backpressure are charged to nobody). "
+               "stage=step is the consumer starving (ingest-bound "
+               "training); stage=materialize is DeviceCachedDataSet's "
+               "blocking first-fill.", ("stage",)),
     # ---- batch evaluation (optim/evaluator.py)
     MetricSpec("bigdl_eval_batches_total", "counter",
                "Evaluation batches scored."),
@@ -258,6 +281,21 @@ SPAN_SPECS: List[Tuple[str, str]] = [
     ("lmserver.gather", "Batcher wait assembling one same-length batch."),
     ("lmserver.decode_batch", "One batched prefill+decode program "
      "(models/lm_server.py)."),
+    ("ingest.read_shard", "Reader-pool thread reading + CRC-verifying one "
+     "shard (and applying its seeded record shuffle) "
+     "(dataset/ingest/engine.py)."),
+    ("ingest.decode", "Decode-pool thread running one record chunk "
+     "through its cloned decode/collate chain."),
+    ("ingest.device_put", "Device-feed thread issuing the async H2D "
+     "transfer of one batch (overlaps the step consuming the previous "
+     "one)."),
+    ("ingest.step", "Consumer-side work between batch pops in "
+     "apps/ingest_bench.py's pipelined measurement (the lane the "
+     "read/decode/device_put spans overlap with)."),
+    ("ingest.materialize", "DeviceCachedDataSet building its whole-epoch "
+     "device cache on first use; the same wall time lands in "
+     "bigdl_ingest_stall_seconds_total{stage=materialize} "
+     "(dataset/device_cache.py)."),
     ("train.dispatch", "Handing one training window to the device (H2D + "
      "enqueue)."),
     ("train.sync", "Blocking fetch of the pipelined window losses."),

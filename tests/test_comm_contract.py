@@ -83,6 +83,44 @@ def test_ring_attention_compiles_to_collective_permute():
     assert "collective-permute" in txt, "ring attention lost its ring"
 
 
+def test_dp_cp_ring_stays_in_coset_and_grads_all_reduce():
+    """dp x cp contract (the long-context pretraining layout): on a
+    (data=2, seq=4) mesh the K/V ring must rotate WITHIN each data
+    group's seq coset — every collective-permute source/target pair
+    stays inside {0..3} or {4..7} — while the replicated-parameter
+    gradients still all-reduce ACROSS groups. A regression that flattens
+    the ring over all 8 devices would mix sequence shards from
+    different batch slices (silent numerics corruption, not a crash)."""
+    import re
+    from bigdl_tpu.utils.jax_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+    from bigdl_tpu.nn.module import functional_apply
+    enc = nn.TransformerEncoder(1, 16, 2, 32, causal=True, seq_axis="seq")
+    mesh = MeshTopology(data=2, sequence=4).build()
+    params, buffers = enc.parameter_tree(), enc.buffer_tree()
+    x = jnp.zeros((4, 16, 16))
+
+    def loss(p, b, xx):
+        y, _ = functional_apply(enc, p, b, xx, training=False)
+        return jnp.sum(y ** 2)
+
+    fn = jax.jit(jax.grad(shard_map(
+        loss, mesh=mesh, in_specs=(P(), P(), P("data", "seq", None)),
+        out_specs=P(), check_vma=False)))
+    txt = fn.lower(params, buffers, x).compile().as_text()
+    assert "collective-permute" in txt, "dp x cp lost its seq ring"
+    assert "all-reduce" in txt, "dp x cp lost its data gradient sync"
+    pair_blobs = re.findall(r"source_target_pairs=\{([^}]+(?:\},\{[^}]+)*)\}",
+                            txt)
+    assert pair_blobs, "no collective-permute pairs in compiled HLO"
+    for blob in pair_blobs:
+        for pair in re.findall(r"(\d+),(\d+)", blob):
+            s, t = int(pair[0]), int(pair[1])
+            assert s // 4 == t // 4, (
+                f"ring hop {s}->{t} crosses the data-group boundary: "
+                "sequence shards from different batch slices got mixed")
+
+
 @pytest.mark.parametrize("dispatch", ["sort", "scatter"])
 def test_expert_parallel_step_routes_over_expert_axis(dispatch):
     """EP collective RECORD (round-5 VERDICT #8): expert parallelism is
